@@ -1,0 +1,51 @@
+"""accelerate_tpu — a TPU-native training/inference framework.
+
+The user contract of HF Accelerate (Accelerator / prepare / backward /
+gather / save_state / launch) rebuilt from scratch on JAX/XLA: GSPMD sharding
+over a `jax.Mesh` instead of DDP/FSDP/DeepSpeed wrappers, one jit-fused train
+step instead of eager backward+step, pallas kernels for long-context
+attention, and an `accelerate-tpu` CLI that launches one process per TPU host.
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, GradientState, PartialState  # noqa: F401
+from .utils.dataclasses import (  # noqa: F401
+    DataLoaderConfiguration,
+    DistributedType,
+    GradientAccumulationPlugin,
+    ProjectConfiguration,
+    ShardingConfig,
+    ShardingStrategy,
+)
+from .logging import get_logger  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy heavy imports so `import accelerate_tpu` stays cheap
+    # (reference keeps import time low too; tests/test_imports.py).
+    if name == "Accelerator":
+        from .accelerator import Accelerator
+
+        return Accelerator
+    if name == "Model":
+        from .accelerator import Model
+
+        return Model
+    if name == "notebook_launcher":
+        from .launchers import notebook_launcher
+
+        return notebook_launcher
+    if name == "debug_launcher":
+        from .launchers import debug_launcher
+
+        return debug_launcher
+    if name in ("init_empty_weights", "dispatch_model", "load_checkpoint_and_dispatch", "infer_auto_device_map"):
+        from . import big_modeling
+
+        return getattr(big_modeling, name)
+    if name == "LocalSGD":
+        from .local_sgd import LocalSGD
+
+        return LocalSGD
+    raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

@@ -1,0 +1,39 @@
+"""`accelerate-tpu` CLI entry (parity: reference commands/accelerate_cli.py).
+
+Subcommands are registered lazily; each lives in its own module. This is a
+stub while the CLI layer is built out — `env` works today.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu", usage="accelerate-tpu <command> [<args>]"
+    )
+    subparsers = parser.add_subparsers(dest="command")
+
+    from . import env
+
+    env.register(subparsers)
+    registered = {"env"}
+    for name in ("config", "launch", "estimate", "merge", "test", "tpu_config"):
+        try:
+            module = __import__(f"accelerate_tpu.commands.{name}", fromlist=["register"])
+            module.register(subparsers)
+            registered.add(name)
+        except ImportError:
+            continue
+
+    args = parser.parse_args(argv)
+    if not getattr(args, "func", None):
+        parser.print_help()
+        return 1
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

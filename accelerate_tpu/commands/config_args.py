@@ -1,0 +1,93 @@
+"""Config file dataclasses + default path (parity: reference
+commands/config/config_args.py, 252 LoC: BaseConfig/ClusterConfig to/from yaml).
+
+The config cascade (SURVEY §5 config/flag system): yaml file < env vars <
+programmatic objects. This module is the yaml layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+DEFAULT_CONFIG_FOLDER = os.environ.get(
+    "ACCELERATE_TPU_CONFIG_HOME", os.path.join(os.path.expanduser("~"), ".cache", "accelerate_tpu")
+)
+
+
+def default_config_file() -> str:
+    return os.path.join(DEFAULT_CONFIG_FOLDER, "default_config.yaml")
+
+
+@dataclass
+class ClusterConfig:
+    """Everything `accelerate-tpu launch` needs to start a run."""
+
+    compute_environment: str = "LOCAL_MACHINE"  # or TPU_POD
+    mixed_precision: str = "no"
+    num_processes: int = 1  # hosts
+    num_devices_per_process: Optional[int] = None
+    main_process_ip: Optional[str] = None
+    main_process_port: Optional[int] = None
+    # sharding
+    sharding_strategy: str = "AUTO"
+    data_parallel: int = -1
+    fsdp: int = 1
+    tensor_parallel: int = 1
+    sequence_parallel: int = 1
+    expert_parallel: int = 1
+    pipeline_parallel: int = 1
+    replica: int = 1
+    # pod fan-out
+    tpu_name: Optional[str] = None
+    tpu_zone: Optional[str] = None
+    tpu_project: Optional[str] = None
+    # misc
+    debug: bool = False
+    downcast_bf16: bool = False
+    compilation_cache_dir: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        result = dataclasses.asdict(self)
+        return {k: v for k, v in result.items() if v is not None}
+
+    def to_yaml_file(self, path: str | os.PathLike):
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        try:
+            import yaml
+
+            with open(path, "w") as f:
+                yaml.safe_dump(self.to_dict(), f)
+        except ImportError:
+            with open(path, "w") as f:
+                json.dump(self.to_dict(), f, indent=2)
+
+    @classmethod
+    def from_yaml_file(cls, path: str | os.PathLike) -> "ClusterConfig":
+        with open(path) as f:
+            raw = f.read()
+        try:
+            import yaml
+
+            data = yaml.safe_load(raw)
+        except ImportError:
+            data = json.loads(raw)
+        known = {f.name for f in dataclasses.fields(cls)}
+        extra = {k: v for k, v in data.items() if k not in known}
+        if extra:
+            import logging
+
+            logging.getLogger(__name__).warning(f"ignoring unknown config keys: {sorted(extra)}")
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+
+def load_config_from_file(path: Optional[str] = None) -> ClusterConfig:
+    path = path or default_config_file()
+    if os.path.isfile(path):
+        return ClusterConfig.from_yaml_file(path)
+    return ClusterConfig()

@@ -1,0 +1,33 @@
+"""`accelerate-tpu env` — platform dump for bug reports (parity: reference
+commands/env.py, 113 LoC)."""
+
+from __future__ import annotations
+
+import os
+
+
+def register(subparsers):
+    parser = subparsers.add_parser("env", help="Print environment information")
+    parser.add_argument("--config_file", default=None, help="Config file to inspect")
+    parser.set_defaults(func=env_command)
+    return parser
+
+
+def env_command(args):
+    import accelerate_tpu
+    from ..utils.environment import get_platform_info
+
+    info = {"`accelerate_tpu` version": accelerate_tpu.__version__}
+    info.update(get_platform_info())
+    config_file = getattr(args, "config_file", None)
+    if config_file is None:
+        from .config_args import default_config_file
+
+        config_file = default_config_file()
+    if config_file and os.path.isfile(config_file):
+        with open(config_file) as f:
+            info["Config"] = f.read().strip()
+
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    print("\n".join(f"- {k}: {v}" for k, v in info.items()))
+    return 0

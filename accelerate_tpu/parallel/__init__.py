@@ -1,0 +1,1 @@
+from .mesh import build_mesh, mesh_shape_dict, single_device_mesh  # noqa: F401

@@ -1,0 +1,112 @@
+"""Device mesh construction over ICI/DCN.
+
+This is the TPU-native replacement for the reference's backend selection +
+``init_process_group`` (/root/reference/src/accelerate/state.py:709-766): the
+"communicator" on TPU is a `jax.sharding.Mesh` whose axis layout decides which
+collectives ride ICI (intra-slice, fast) vs DCN (inter-slice). We put the
+`replica` axis outermost (DCN) and compute-heavy axes (`tensor`, `sequence`)
+innermost (ICI-contiguous) following the hybrid-mesh recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from ..utils.constants import MESH_AXIS_ORDER
+
+
+def build_mesh(
+    axis_sizes: Mapping[str, int],
+    *,
+    devices: Sequence | None = None,
+    allow_split_physical_axes: bool = True,
+) -> Mesh:
+    """Build a Mesh with the canonical axis order, dropping size-1 axes is NOT
+    done — keeping all axes lets sharding specs reference any axis regardless
+    of degree (size-1 axes cost nothing).
+
+    ``axis_sizes`` must multiply to the device count. When multiple DCN slices
+    are present (multi-host with slice_index metadata), the outermost axes are
+    mapped onto DCN via ``create_hybrid_device_mesh``.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    names = [n for n in MESH_AXIS_ORDER if n in axis_sizes]
+    extra = [n for n in axis_sizes if n not in MESH_AXIS_ORDER]
+    names += extra  # user-defined axes go innermost
+    sizes = [int(axis_sizes[n]) for n in names]
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {len(devices)} devices")
+
+    num_slices = _num_dcn_slices(devices)
+    if num_slices > 1:
+        # Split axes into DCN (outer) and ICI (inner) groups such that the
+        # product of the DCN group equals the slice count.
+        dcn_sizes, ici_sizes = _split_for_dcn(sizes, num_slices)
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            ici_sizes,
+            dcn_sizes,
+            devices=devices,
+            allow_split_physical_axes=allow_split_physical_axes,
+        )
+    else:
+        try:
+            device_array = mesh_utils.create_device_mesh(
+                sizes, devices=devices, allow_split_physical_axes=allow_split_physical_axes
+            )
+        except (ValueError, AssertionError, NotImplementedError):
+            # Fallback for virtual/CPU devices with no physical coords.
+            device_array = np.asarray(devices).reshape(sizes)
+    return Mesh(device_array, axis_names=tuple(names))
+
+
+def _num_dcn_slices(devices) -> int:
+    slice_ids = set()
+    for d in devices:
+        sid = getattr(d, "slice_index", None)
+        if sid is None:
+            return 1
+        slice_ids.add(sid)
+    return max(1, len(slice_ids))
+
+
+def _split_for_dcn(sizes: list[int], num_slices: int) -> tuple[list[int], list[int]]:
+    """Factor the outermost axes onto DCN so their product == num_slices.
+
+    Returns (dcn_sizes, ici_sizes), each the same length as ``sizes`` with 1s
+    in the positions assigned to the other network, as
+    ``create_hybrid_device_mesh`` expects.
+    """
+    dcn = [1] * len(sizes)
+    ici = list(sizes)
+    remaining = num_slices
+    for i, s in enumerate(sizes):
+        if remaining == 1:
+            break
+        if s % remaining == 0:
+            dcn[i], ici[i] = remaining, s // remaining
+            remaining = 1
+        elif remaining % s == 0 and s > 1:
+            dcn[i], ici[i] = s, 1
+            remaining //= s
+    if remaining != 1:
+        raise ValueError(
+            f"cannot map mesh {sizes} onto {num_slices} DCN slices: make the "
+            "outermost axis degrees divisible by the slice count"
+        )
+    return dcn, ici
+
+
+def single_device_mesh(device=None) -> Mesh:
+    device = device or jax.devices()[0]
+    arr = np.asarray([device]).reshape((1,) * len(MESH_AXIS_ORDER))
+    return Mesh(arr, axis_names=MESH_AXIS_ORDER)
+
+
+def mesh_shape_dict(mesh: Mesh) -> dict:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,46 @@
+"""Framework-wide constants.
+
+Capability parity with the reference's ``utils/constants.py``
+(/root/reference/src/accelerate/utils/constants.py:22-45): checkpoint file
+names, option lists, env-var prefixes — re-chosen for a JAX/TPU runtime.
+"""
+
+# Checkpoint artifact names (reference: MODEL_NAME="pytorch_model" etc.)
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+DATALOADER_STATE_NAME = "dl_state"
+RNG_STATE_NAME = "random_states"
+SCALER_NAME = "loss_scale"
+CUSTOM_STATE_PATTERN = "custom_checkpoint_{}"
+CHECKPOINT_DIR_PREFIX = "checkpoint"
+
+# Env-var prefix for everything the launcher communicates to workers
+# (reference uses ACCELERATE_*; we keep a distinct prefix to avoid collisions
+# when both frameworks are installed).
+ENV_PREFIX = "ACCELERATE_TPU_"
+
+# Sharding strategy names (reference FSDP_SHARDING_STRATEGY, constants.py:36)
+SHARDING_STRATEGIES = ["NO", "DP", "FSDP", "HYBRID_SHARD", "TP", "SP", "EP", "PP"]
+
+# Mesh axis canon. Order matters: ICI-heavy axes innermost (fastest-varying)
+# so that tensor/sequence collectives ride ICI; replica/stage ride outer links.
+MESH_AXIS_ORDER = ("replica", "stage", "data", "fsdp", "expert", "sequence", "tensor")
+
+# Logical axis names models may use in nn.with_partitioning annotations.
+LOGICAL_AXES = (
+    "batch", "seq", "embed", "mlp", "heads", "kv_heads", "head_dim",
+    "vocab", "expert", "stage",
+)
+
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+WEIGHTS_NAME = "model.msgpack"
+WEIGHTS_INDEX_NAME = "model.msgpack.index.json"
+
+PROFILE_PATTERN_NAME = "profile_{suffix}"
+
+# Sentinel sizes
+MB = 1024 * 1024
+GB = 1024 * MB

@@ -1,0 +1,422 @@
+"""Configuration dataclasses, enums and kwargs handlers.
+
+Parity target: /root/reference/src/accelerate/utils/dataclasses.py (2,219 LoC).
+The reference ships one plugin per external engine (DeepSpeedPlugin,
+FullyShardedDataParallelPlugin, MegatronLMPlugin, TorchDynamoPlugin...).
+On TPU all of those collapse into ONE concept — how the `jax.Mesh` is laid out
+and how arrays are sharded over it — so this module defines a single
+:class:`ShardingConfig` covering DP / FSDP(ZeRO) / HYBRID / TP / SP / EP / PP,
+plus the cross-cutting configs the reference also has (DataLoaderConfiguration,
+ProjectConfiguration, GradientAccumulationPlugin, kwargs handlers, enums).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import enum
+import functools
+import os
+import warnings
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Callable, Iterable, Optional
+
+from .constants import MESH_AXIS_ORDER
+from .environment import get_env, parse_flag_from_env
+
+
+class KwargsHandler:
+    """Base for kwargs dataclasses: ``to_kwargs()`` diffs against defaults.
+
+    Mirrors reference utils/dataclasses.py:45-60.
+    """
+
+    def to_dict(self):
+        return copy.deepcopy(self.__dict__)
+
+    def to_kwargs(self):
+        default = self.__class__()
+        this = self.to_dict()
+        return {k: v for k, v in this.items() if getattr(default, k) != v}
+
+
+# ---------------------------------------------------------------------------
+# Enums
+# ---------------------------------------------------------------------------
+
+class BaseEnum(str, enum.Enum):
+    @classmethod
+    def list(cls):
+        return [e.value for e in cls]
+
+    def __str__(self):
+        return self.value
+
+
+class DistributedType(BaseEnum):
+    """Runtime topology (reference utils/dataclasses.py:530-560).
+
+    The reference's vendor axis (MULTI_GPU/NPU/MLU/...) collapses: JAX owns
+    device discovery. What remains meaningful on TPU:
+      - NO: one device, one process.
+      - TPU: one process driving multiple local devices (single-host SPMD).
+      - MULTI_HOST: a pod — many processes, `jax.distributed` initialized,
+        mesh spans ICI within a slice and DCN across slices.
+      - CPU_SIM: XLA host-platform simulation (tests / dry-runs).
+    """
+
+    NO = "NO"
+    TPU = "TPU"
+    MULTI_HOST = "MULTI_HOST"
+    CPU_SIM = "CPU_SIM"
+
+
+class ShardingStrategy(BaseEnum):
+    """How parameters/optimizer state are laid out over the mesh.
+
+    Covers the reference's DistributedType strategy surface (DDP, FSDP
+    sharding strategies constants.py:36, DeepSpeed ZeRO stages, Megatron
+    TP/PP/SP) as mesh-axis policies:
+      - DP          ≙ DDP / ZeRO-0: params replicated, batch sharded.
+      - FSDP        ≙ FULL_SHARD / ZeRO-3: params+grads+opt sharded.
+      - GRAD_OP     ≙ SHARD_GRAD_OP / ZeRO-2: opt+grads sharded, params
+                      replicated in compute (XLA materializes via all-gather).
+      - HYBRID      ≙ HYBRID_SHARD: shard within slice (ICI), replicate
+                      across slices (DCN).
+      - AUTO        : infer from mesh axis sizes.
+    TP/SP/EP/PP are orthogonal axes configured on ShardingConfig directly.
+    """
+
+    AUTO = "AUTO"
+    DP = "DP"
+    FSDP = "FSDP"
+    GRAD_OP = "GRAD_OP"
+    HYBRID = "HYBRID"
+
+
+class PrecisionType(BaseEnum):
+    """Mixed-precision modes (reference utils/dataclasses.py:566-578)."""
+
+    NO = "no"
+    BF16 = "bf16"
+    FP16 = "fp16"
+    FP8 = "fp8"
+
+
+class RNGType(BaseEnum):
+    """RNG streams we synchronize/checkpoint (reference :596-608)."""
+
+    JAX = "jax"
+    NUMPY = "numpy"
+    PYTHON = "python"
+    TORCH = "torch"
+    GENERATOR = "generator"
+
+
+class LoggerType(BaseEnum):
+    ALL = "all"
+    TENSORBOARD = "tensorboard"
+    WANDB = "wandb"
+    MLFLOW = "mlflow"
+    COMETML = "comet_ml"
+    AIM = "aim"
+    CLEARML = "clearml"
+    DVCLIVE = "dvclive"
+    JSONL = "jsonl"
+
+
+class SaveFormat(BaseEnum):
+    SAFETENSORS = "safetensors"
+    MSGPACK = "msgpack"
+    ORBAX = "orbax"
+
+
+# ---------------------------------------------------------------------------
+# Kwargs handlers (reference :90-528)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AutocastKwargs(KwargsHandler):
+    """Tunes the mixed-precision policy (reference :90-110).
+
+    On TPU there is no autocast context; the policy is applied when the step
+    is staged. ``enabled=False`` escapes a region to full precision.
+    """
+
+    enabled: bool = True
+    cache_enabled: bool = True  # accepted for API parity; no-op under XLA
+
+
+@dataclass
+class GradScalerKwargs(KwargsHandler):
+    """Dynamic loss-scaling knobs for fp16 (reference :209-239).
+
+    Maps to our DynamicLossScale (utils/loss_scale.py): growth_factor /
+    backoff_factor / growth_interval keep their reference meaning.
+    """
+
+    init_scale: float = 65536.0
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    enabled: bool = True
+
+
+@dataclass
+class InitProcessGroupKwargs(KwargsHandler):
+    """Multi-host init knobs (reference :240-276). Maps onto
+    jax.distributed.initialize(coordinator_address, num_processes, process_id).
+    """
+
+    backend: Optional[str] = "jax"
+    init_method: Optional[str] = None
+    timeout: Optional[timedelta] = None
+
+
+@dataclass
+class DistributedDataParallelKwargs(KwargsHandler):
+    """Accepted for API parity (reference :132-208). Most knobs are
+    meaningless under GSPMD (bucketing, broadcast_buffers); gradient
+    compression hooks map to ``comm_dtype``.
+    """
+
+    bucket_cap_mb: int = 25  # no-op
+    find_unused_parameters: bool = False  # no-op
+    static_graph: bool = False  # no-op (everything is static under jit)
+    comm_dtype: Optional[str] = None  # "fp16"/"bf16" grad all-reduce compression
+
+
+@dataclass
+class ProfileKwargs(KwargsHandler):
+    """jax.profiler configuration (reference :400-505 wraps torch.profiler).
+
+    ``output_trace_dir`` receives per-host xplane/perfetto traces.
+    """
+
+    activities: Optional[list] = None  # parity; jax traces host+device always
+    schedule_option: Optional[dict] = None
+    on_trace_ready: Optional[Callable] = None
+    record_shapes: bool = False
+    profile_memory: bool = False
+    with_stack: bool = False
+    with_flops: bool = False
+    output_trace_dir: Optional[str] = None
+
+    def build(self, suffix: str = "0"):
+        from .profiler import ProfileContext
+
+        return ProfileContext(self, suffix=suffix)
+
+
+# ---------------------------------------------------------------------------
+# Core configuration dataclasses
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DataLoaderConfiguration:
+    """Reference utils/dataclasses.py:733-789, same field meanings.
+
+    ``even_batches``: pad/wrap the last global batch so every process gets the
+    same count (remainder tracked for gather_for_metrics dedup).
+    ``split_batches``: batch_size is the GLOBAL size, split over processes.
+    ``dispatch_batches``: rank0 iterates and broadcasts (only useful for
+    non-deterministic/streaming datasets; on TPU the default per-host feed is
+    faster).
+    """
+
+    split_batches: bool = False
+    dispatch_batches: Optional[bool] = None
+    even_batches: bool = True
+    use_seedable_sampler: bool = True
+    non_blocking: bool = False
+    use_stateful_dataloader: bool = False
+    data_sharding_axes: Optional[tuple] = None  # mesh axes the batch dim is sharded over
+
+
+@dataclass
+class ProjectConfiguration:
+    """Reference :790-837."""
+
+    project_dir: Optional[str] = None
+    logging_dir: Optional[str] = None
+    automatic_checkpoint_naming: bool = False
+    total_limit: Optional[int] = None
+    iteration: int = 0
+    save_on_each_node: bool = False
+
+    def set_directories(self, project_dir: Optional[str] = None):
+        self.project_dir = project_dir
+        if self.logging_dir is None:
+            self.logging_dir = project_dir
+
+    def __post_init__(self):
+        self.set_directories(self.project_dir)
+
+
+@dataclass
+class GradientAccumulationPlugin(KwargsHandler):
+    """Reference :838-886. ``sync_with_dataloader`` forces a sync step at the
+    end of each dataloader pass; ``sync_each_batch`` disables local-only
+    accumulation (on TPU this means grads are psum'd every micro-batch rather
+    than once — mostly useful to bound memory)."""
+
+    num_steps: int = 1
+    adjust_scheduler: bool = True
+    sync_with_dataloader: bool = True
+    sync_each_batch: bool = False
+
+
+@dataclass
+class ShardingConfig:
+    """THE parallelism plugin: declares the mesh and how state maps onto it.
+
+    Replaces FullyShardedDataParallelPlugin (:1260-1610), DeepSpeedPlugin
+    (:923-1259) and MegatronLMPlugin (:1611-1927) with mesh-axis degrees:
+
+      data_parallel      batch-dim sharding, params replicated (DDP analog)
+      fsdp               params/grads/opt sharded over this axis (ZeRO-3)
+      tensor_parallel    logical-axis-rules shard attention heads / mlp
+      sequence_parallel  shard sequence dim (ring attention over ICI)
+      expert_parallel    MoE expert axis (all_to_all dispatch)
+      pipeline_parallel  stage axis (looped pipelines)
+      replica            outermost DCN axis for HYBRID (multi-slice)
+
+    -1 for any degree means "absorb all remaining devices".
+    ``axis_rules`` override the default logical→mesh mapping
+    (parallel/sharding.py:DEFAULT_AXIS_RULES).
+    """
+
+    strategy: ShardingStrategy = ShardingStrategy.AUTO
+    data_parallel: int = -1
+    fsdp: int = 1
+    tensor_parallel: int = 1
+    sequence_parallel: int = 1
+    expert_parallel: int = 1
+    pipeline_parallel: int = 1
+    replica: int = 1
+    axis_rules: Optional[tuple] = None
+    # FSDP-detail parity knobs
+    min_weight_size_to_shard: int = 2**18  # don't shard tiny params (biases, norms)
+    offload_params_to_host: bool = False   # ≙ FSDP cpu_offload: pinned_host memory kind
+    remat_policy: Optional[str] = None     # "full" | "nothing_saveable" | "dots_saveable" | None
+    use_shard_map: bool = False            # escape hatch: explicit shard_map instead of GSPMD
+
+    def __post_init__(self):
+        if isinstance(self.strategy, str):
+            self.strategy = ShardingStrategy(self.strategy.upper())
+        degrees = self.axis_degrees()
+        explicit = [d for d in degrees.values() if d != -1]
+        if any(d == 0 for d in explicit):
+            raise ValueError("mesh axis degrees must be >= 1 (or -1 for 'rest')")
+        if sum(1 for d in degrees.values() if d == -1) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+
+    def axis_degrees(self) -> dict:
+        return {
+            "replica": self.replica,
+            "stage": self.pipeline_parallel,
+            "data": self.data_parallel,
+            "fsdp": self.fsdp,
+            "expert": self.expert_parallel,
+            "sequence": self.sequence_parallel,
+            "tensor": self.tensor_parallel,
+        }
+
+    def resolve(self, n_devices: int) -> dict:
+        """Concrete axis sizes for ``n_devices``, expanding the -1 axis."""
+        degrees = dict(self.axis_degrees())
+        if self.strategy == ShardingStrategy.FSDP and self.fsdp == 1 and self.data_parallel == -1:
+            # strategy=FSDP with no explicit degrees: all devices on fsdp axis
+            degrees["fsdp"], degrees["data"] = -1, 1
+        if self.strategy == ShardingStrategy.HYBRID and self.replica == 1:
+            # HYBRID with unspecified replica: one replica per DCN slice when
+            # known, else leave as configured.
+            pass
+        fixed = 1
+        wild = None
+        for name, d in degrees.items():
+            if d == -1:
+                wild = name
+            else:
+                fixed *= d
+        if wild is None:
+            if fixed != n_devices:
+                raise ValueError(
+                    f"mesh {degrees} needs {fixed} devices but {n_devices} are available"
+                )
+        else:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"cannot fit mesh {degrees}: {n_devices} devices not divisible by {fixed}"
+                )
+            degrees[wild] = n_devices // fixed
+        return {name: degrees[name] for name in MESH_AXIS_ORDER}
+
+
+@dataclass
+class MixedPrecisionConfig:
+    """The staged-step precision policy (replaces GradScaler + autocast).
+
+    compute_dtype: activations/matmuls; param_dtype: master weights;
+    output_dtype: what user-visible outputs are cast to (reference upcasts
+    fp16 outputs to fp32, operations.py:766-826 — we do the same).
+    """
+
+    mode: PrecisionType = PrecisionType.NO
+    compute_dtype: Any = None
+    param_dtype: Any = None
+    output_dtype: Any = None
+    grad_scaler: GradScalerKwargs = field(default_factory=GradScalerKwargs)
+
+    def __post_init__(self):
+        import jax.numpy as jnp
+
+        if isinstance(self.mode, str):
+            self.mode = PrecisionType(self.mode)
+        defaults = {
+            PrecisionType.NO: (jnp.float32, jnp.float32, jnp.float32),
+            PrecisionType.BF16: (jnp.bfloat16, jnp.float32, jnp.float32),
+            PrecisionType.FP16: (jnp.float16, jnp.float32, jnp.float32),
+            # fp8 matmul inputs; params stay f32, see ops/fp8.py
+            PrecisionType.FP8: (jnp.bfloat16, jnp.float32, jnp.float32),
+        }
+        c, p, o = defaults[self.mode]
+        self.compute_dtype = self.compute_dtype or c
+        self.param_dtype = self.param_dtype or p
+        self.output_dtype = self.output_dtype or o
+
+    @property
+    def needs_loss_scaling(self) -> bool:
+        return self.mode == PrecisionType.FP16 and self.grad_scaler.enabled
+
+
+# ---------------------------------------------------------------------------
+# Compile / dynamo parity
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CompilePlugin(KwargsHandler):
+    """Reference TorchDynamoPlugin (:887-922). Under JAX everything is
+    jit-compiled already; this controls HOW:
+    ``donate_state``: donate params/opt buffers to the step (halves HBM churn);
+    ``cache_dir``: persistent XLA compilation cache.
+    """
+
+    enabled: bool = True
+    donate_state: bool = True
+    cache_dir: Optional[str] = None
+    fullgraph: bool = True  # parity no-op: jit is always full-graph
+
+    def apply_cache(self):
+        if self.cache_dir:
+            from jax.experimental.compilation_cache import compilation_cache
+
+            compilation_cache.set_cache_dir(self.cache_dir)
+
+
+def add_model_config_to_megatron_parity(*_a, **_k):  # pragma: no cover
+    raise NotImplementedError(
+        "Megatron-LM delegation does not exist on TPU: use ShardingConfig("
+        "tensor_parallel=..., pipeline_parallel=..., sequence_parallel=...)."
+    )

@@ -1,0 +1,152 @@
+"""Environment parsing & host topology probes.
+
+Parity target: /root/reference/src/accelerate/utils/environment.py (274 LoC):
+``str_to_bool``, ``parse_flag_from_env``, ``parse_choice_from_env``, CPU
+topology helpers. GPU probing (nvidia-smi, p2p quirks, NUMA pinning) is
+replaced by TPU topology discovery from libtpu/JAX and GCE metadata envs.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import socket
+from functools import lru_cache
+
+from .constants import ENV_PREFIX
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a string into 1 (truthy) / 0 (falsy); raise otherwise.
+
+    Mirrors reference utils/environment.py:58-73.
+    """
+    value = value.lower()
+    if value in ("y", "yes", "t", "true", "on", "1"):
+        return 1
+    if value in ("n", "no", "f", "false", "off", "0"):
+        return 0
+    raise ValueError(f"invalid truth value {value!r}")
+
+
+def get_int_from_env(env_keys, default):
+    """First integer found among ``env_keys`` (reference :76-81)."""
+    for e in env_keys:
+        val = int(os.environ.get(e, -1))
+        if val >= 0:
+            return val
+    return default
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key, str(default))
+    try:
+        return bool(str_to_bool(value))
+    except ValueError:
+        raise ValueError(f"If set, {key} must be yes/no/true/false, got {value!r}.")
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, str(default))
+
+
+def env_var(name: str) -> str:
+    """Fully-prefixed framework env var name."""
+    return ENV_PREFIX + name
+
+
+def get_env(name: str, default=None):
+    return os.environ.get(env_var(name), default)
+
+
+def get_flag(name: str, default: bool = False) -> bool:
+    return parse_flag_from_env(env_var(name), default)
+
+
+def is_debug_mode() -> bool:
+    """Collective desync-detection mode (reference state.py:175)."""
+    return get_flag("DEBUG_MODE", False)
+
+
+@lru_cache(maxsize=None)
+def get_cpu_count() -> int:
+    return os.cpu_count() or 1
+
+
+def get_hostname() -> str:
+    return socket.gethostname()
+
+
+def get_platform_info() -> dict:
+    """Used by `accelerate-tpu env` (reference commands/env.py)."""
+    import numpy as np
+
+    info = {
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "Numpy version": np.__version__,
+        "Hostname": get_hostname(),
+        "CPU count": get_cpu_count(),
+    }
+    try:
+        import jax
+
+        info["JAX version"] = jax.__version__
+        info["JAX backend"] = jax.default_backend()
+        info["Device count"] = jax.device_count()
+        info["Local device count"] = jax.local_device_count()
+        info["Process count"] = jax.process_count()
+        info["Devices"] = ", ".join(str(d) for d in jax.local_devices())
+    except Exception as e:  # pragma: no cover - only when jax broken
+        info["JAX"] = f"unavailable ({e})"
+    return info
+
+
+# ---------------------------------------------------------------------------
+# Multi-host (pod) topology from env. The launcher (commands/launch.py) writes
+# these; `jax.distributed.initialize` consumes them. Analogous to the
+# MASTER_ADDR/RANK/WORLD_SIZE contract in reference utils/launch.py:91-117.
+# ---------------------------------------------------------------------------
+
+def get_coordinator_address() -> str | None:
+    return get_env("COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS")
+
+
+def get_process_id() -> int | None:
+    v = get_env("PROCESS_ID") or os.environ.get("PROCESS_ID")
+    return int(v) if v is not None else None
+
+
+def get_num_processes_env() -> int | None:
+    v = get_env("NUM_PROCESSES") or os.environ.get("NUM_PROCESSES")
+    return int(v) if v is not None else None
+
+
+def is_port_in_use(port: int) -> bool:
+    """Reference utils/other.py:313."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        return s.connect_ex(("localhost", port)) == 0
+
+
+def find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def check_os_kernel():
+    """Warn on old Linux kernels with known shm hangs (reference other.py:334)."""
+    import logging
+
+    if platform.system() != "Linux":
+        return
+    release = platform.release().split("-")[0]
+    try:
+        parts = [int(p) for p in release.split(".")[:2]]
+    except ValueError:
+        return
+    if parts < [5, 5]:
+        logging.getLogger(__name__).warning(
+            f"Detected kernel version {release}, below the recommended minimum of 5.5; "
+            "this can cause the process to hang."
+        )

@@ -1,0 +1,111 @@
+"""Availability probes (reference utils/imports.py: ~60 is_*_available fns).
+
+The TPU build's probe set covers the libraries this framework can integrate
+with. All probes are cached and import-cheap.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from functools import lru_cache
+
+
+def _package_available(name: str) -> bool:
+    return importlib.util.find_spec(name) is not None
+
+
+@lru_cache(maxsize=None)
+def is_torch_available() -> bool:
+    return _package_available("torch")
+
+
+@lru_cache(maxsize=None)
+def is_transformers_available() -> bool:
+    return _package_available("transformers")
+
+
+@lru_cache(maxsize=None)
+def is_datasets_available() -> bool:
+    return _package_available("datasets")
+
+
+@lru_cache(maxsize=None)
+def is_flax_available() -> bool:
+    return _package_available("flax")
+
+
+@lru_cache(maxsize=None)
+def is_orbax_available() -> bool:
+    return _package_available("orbax")
+
+
+@lru_cache(maxsize=None)
+def is_safetensors_available() -> bool:
+    return _package_available("safetensors")
+
+
+@lru_cache(maxsize=None)
+def is_tensorboard_available() -> bool:
+    # torch (cpu) ships torch.utils.tensorboard; tensorboardX also counts.
+    return _package_available("tensorboard") or _package_available("tensorboardX") or is_torch_available()
+
+
+@lru_cache(maxsize=None)
+def is_wandb_available() -> bool:
+    return _package_available("wandb")
+
+
+@lru_cache(maxsize=None)
+def is_mlflow_available() -> bool:
+    return _package_available("mlflow")
+
+
+@lru_cache(maxsize=None)
+def is_comet_ml_available() -> bool:
+    return _package_available("comet_ml")
+
+
+@lru_cache(maxsize=None)
+def is_aim_available() -> bool:
+    return _package_available("aim")
+
+
+@lru_cache(maxsize=None)
+def is_clearml_available() -> bool:
+    return _package_available("clearml")
+
+
+@lru_cache(maxsize=None)
+def is_dvclive_available() -> bool:
+    return _package_available("dvclive")
+
+
+@lru_cache(maxsize=None)
+def is_rich_available() -> bool:
+    return _package_available("rich")
+
+
+@lru_cache(maxsize=None)
+def is_pandas_available() -> bool:
+    return _package_available("pandas")
+
+
+@lru_cache(maxsize=None)
+def is_tpu_available() -> bool:
+    """True when a real TPU backend is live (not the CPU simulator)."""
+    try:
+        import jax
+
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+@lru_cache(maxsize=None)
+def is_pallas_available() -> bool:
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,114 @@
+"""Memory utilities (parity: reference utils/memory.py, 161 LoC).
+
+``find_executable_batch_size`` halves the batch size and retries when the
+wrapped function hits an accelerator OOM. On TPU the failure modes are XLA
+RESOURCE_EXHAUSTED errors (HBM OOM at compile or run time), detected by
+message inspection — the analog of the reference's CUDA OOM string matching
+(memory.py:88-104).
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+
+import jax
+
+
+def release_memory(*objects):
+    """Drop references + collect (reference memory.py:58). Deleting the last
+    reference to a jax.Array frees its HBM."""
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        objects[i] = None
+    gc.collect()
+    clear_device_cache()
+    return objects
+
+
+def clear_device_cache(garbage_collection: bool = False):
+    if garbage_collection:
+        gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+
+
+OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "OOM",
+    "Attempting to reserve",
+    "exceeds the limit",
+    "Ran out of memory",
+)
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """Detect HBM/host OOM (reference memory.py:88)."""
+    if isinstance(exception, MemoryError):
+        return True
+    msg = str(exception)
+    return any(m in msg for m in OOM_MARKERS)
+
+
+def find_executable_batch_size(function=None, starting_batch_size: int = 128):
+    """Decorator: retry ``function(batch_size, ...)`` with halved batch sizes
+    on OOM (reference memory.py:106-161). The wrapped function must take
+    ``batch_size`` as its first argument."""
+    if function is None:
+        return functools.partial(find_executable_batch_size, starting_batch_size=starting_batch_size)
+
+    batch_size = starting_batch_size
+
+    def decorator(*args, **kwargs):
+        nonlocal batch_size
+        clear_device_cache(garbage_collection=True)
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < (len(args) + 1):
+            arg_str = ", ".join([f"{arg}={value}" for arg, value in zip(params[1:], args[1:])])
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument "
+                f"when called.\nRemove this as the decorator already does so: "
+                f"`{function.__name__}({arg_str})`"
+            )
+        while True:
+            if batch_size == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size, *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size //= 2
+                else:
+                    raise
+
+    return decorator
+
+
+def get_hbm_stats(device=None) -> dict:
+    """Per-device HBM usage, when the backend exposes it."""
+    device = device or jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+        return {
+            "bytes_in_use": stats.get("bytes_in_use", 0),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+            "bytes_limit": stats.get("bytes_limit", 0),
+        }
+    except Exception:
+        return {}
+
+
+def convert_bytes(size: float) -> str:
+    """Human-readable bytes (reference other.py:324)."""
+    for unit in ["bytes", "KB", "MB", "GB", "TB"]:
+        if size < 1024.0:
+            return f"{round(size, 2)} {unit}"
+        size /= 1024.0
+    return f"{round(size, 2)} PB"

@@ -1,0 +1,80 @@
+"""Misc utilities (parity: reference utils/other.py, 366 LoC)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Mapping
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def clear_environment():
+    """Temporarily empty os.environ (reference other.py:211)."""
+    backup = os.environ.copy()
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(backup)
+
+
+@contextlib.contextmanager
+def patch_environment(**kwargs):
+    """Temporarily set env vars (reference other.py:246) — the universal test
+    fixture."""
+    existing = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        if key in os.environ:
+            existing[key] = os.environ[key]
+        os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key in kwargs:
+            key = key.upper()
+            if key in existing:
+                os.environ[key] = existing[key]
+            else:
+                os.environ.pop(key, None)
+
+
+def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True):
+    """Unwrap a prepared model back to the user object (reference other.py:56)."""
+    from ..accelerator import PreparedModel
+
+    if isinstance(model, PreparedModel):
+        return model.unwrap()
+    return model
+
+
+def save(obj, path, save_on_each_node: bool = False, safe_serialization: bool = True):
+    """Rank-conditional save of a params pytree (reference other.py:176)."""
+    from ..state import PartialState
+
+    state = PartialState()
+    if state.is_main_process or save_on_each_node:
+        from .serialization import save_pytree
+
+        save_pytree(obj, path, safe_serialization=safe_serialization)
+
+
+def wait_for_everyone():
+    from ..state import PartialState
+
+    PartialState().wait_for_everyone()
+
+
+def merge_dicts(source: Mapping, destination: dict) -> dict:
+    """Recursive dict merge (reference other.py:296)."""
+    for key, value in source.items():
+        if isinstance(value, Mapping):
+            node = destination.setdefault(key, {})
+            merge_dicts(value, node)
+        else:
+            destination[key] = value
+    return destination

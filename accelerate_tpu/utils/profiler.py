@@ -1,0 +1,48 @@
+"""Profiling context (parity: reference ProfileKwargs wrapping torch.profiler,
+utils/dataclasses.py:400-505 + accelerator.py:3423-3481).
+
+Wraps `jax.profiler` — emits per-host xplane traces viewable in
+TensorBoard/XProf or convertible to perfetto.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+class ProfileContext:
+    def __init__(self, kwargs, suffix: str = "0"):
+        self.kwargs = kwargs
+        self.suffix = suffix
+        self.trace_dir = kwargs.output_trace_dir
+        self._tmp = None
+
+    def __enter__(self):
+        import jax
+
+        if self.trace_dir is None:
+            self._tmp = tempfile.mkdtemp(prefix="accelerate_tpu_profile_")
+            self.trace_dir = self._tmp
+        os.makedirs(self.trace_dir, exist_ok=True)
+        jax.profiler.start_trace(
+            self.trace_dir,
+            create_perfetto_trace=bool(getattr(self.kwargs, "with_stack", False)),
+        )
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+
+        jax.profiler.stop_trace()
+        cb = getattr(self.kwargs, "on_trace_ready", None)
+        if cb is not None:
+            cb(self)
+        return False
+
+
+def annotate(name: str):
+    """Named trace region (shows up in the device timeline)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

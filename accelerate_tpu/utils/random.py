@@ -1,0 +1,144 @@
+"""Seeding & RNG synchronization (parity: reference utils/random.py, 132 LoC).
+
+JAX RNG is counter-based (threefry keys), so "synchronizing RNG state across
+processes" (reference synchronize_rng_state, random.py:66) is mostly free:
+every process derives the same key from the same seed. What we keep stateful
+and checkpointable:
+
+- a process-global `KeyChain` (named threefry streams, e.g. "dataloader",
+  "dropout") whose keys advance deterministically per fold;
+- python/numpy/torch global RNGs, still seeded for host-side code (samplers,
+  augmentation) exactly as the reference does.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional
+
+import jax
+import numpy as np
+
+from .dataclasses import RNGType
+from .imports import is_torch_available
+
+
+class KeyChain:
+    """Named, checkpointable threefry streams."""
+
+    def __init__(self, seed: int = 0):
+        self.seed(seed)
+
+    def seed(self, seed: int):
+        self._seed = int(seed)
+        self._counters: dict[str, int] = {}
+
+    def next_key(self, name: str = "default") -> jax.Array:
+        count = self._counters.get(name, 0)
+        self._counters[name] = count + 1
+        key = jax.random.key(self._seed)
+        return jax.random.fold_in(jax.random.fold_in(key, _stable_hash(name)), count)
+
+    def peek_counter(self, name: str = "default") -> int:
+        return self._counters.get(name, 0)
+
+    def state_dict(self) -> dict:
+        return {"seed": self._seed, "counters": dict(self._counters)}
+
+    def load_state_dict(self, state: dict):
+        self._seed = int(state["seed"])
+        self._counters = dict(state["counters"])
+
+
+def _stable_hash(name: str) -> int:
+    h = 2166136261
+    for ch in name.encode():
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+_GLOBAL_KEYCHAIN = KeyChain(0)
+
+
+def default_keychain() -> KeyChain:
+    return _GLOBAL_KEYCHAIN
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False):
+    """Seed python/numpy/torch/jax (reference random.py:31). With
+    ``device_specific`` each process offsets by its index (for independent
+    data augmentation streams)."""
+    from ..state import PartialState
+
+    if device_specific and PartialState._shared_state:
+        seed += PartialState().process_index
+    random.seed(seed)
+    np.random.seed(seed & 0xFFFFFFFF)
+    if is_torch_available():
+        import torch
+
+        torch.manual_seed(seed)
+        if deterministic:
+            torch.use_deterministic_algorithms(True)
+    _GLOBAL_KEYCHAIN.seed(seed)
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None):
+    """Broadcast rank-0's RNG state to all processes (reference random.py:66).
+
+    JAX streams need no sync (same seed ⇒ same keys). Python/numpy/torch
+    host RNGs are synced via object broadcast.
+    """
+    from ..state import PartialState
+    from .operations import broadcast_object_list
+
+    state = PartialState()
+    if state.num_processes == 1 or rng_type == RNGType.JAX:
+        return
+    if rng_type == RNGType.PYTHON:
+        payload = [random.getstate()]
+        payload = broadcast_object_list(payload)
+        random.setstate(payload[0])
+    elif rng_type == RNGType.NUMPY:
+        payload = [np.random.get_state()]
+        payload = broadcast_object_list(payload)
+        np.random.set_state(payload[0])
+    elif rng_type == RNGType.TORCH and is_torch_available():
+        import torch
+
+        payload = [torch.get_rng_state()]
+        payload = broadcast_object_list(payload)
+        torch.set_rng_state(payload[0])
+    elif rng_type == RNGType.GENERATOR and generator is not None:
+        payload = [generator.get_state()]
+        payload = broadcast_object_list(payload)
+        generator.set_state(payload[0])
+
+
+def synchronize_rng_states(rng_types: Iterable, generator=None):
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(rng_type) if not isinstance(rng_type, RNGType) else rng_type, generator=generator)
+
+
+def rng_state_dict() -> dict:
+    """Everything needed to resume RNG exactly (reference checkpointing.py:145-161)."""
+    state = {
+        "python": random.getstate(),
+        "numpy": np.random.get_state(),
+        "keychain": _GLOBAL_KEYCHAIN.state_dict(),
+    }
+    if is_torch_available():
+        import torch
+
+        state["torch"] = torch.get_rng_state()
+    return state
+
+
+def load_rng_state_dict(state: dict):
+    random.setstate(state["python"])
+    np.random.set_state(state["numpy"])
+    _GLOBAL_KEYCHAIN.load_state_dict(state["keychain"])
+    if "torch" in state and is_torch_available():
+        import torch
+
+        torch.set_rng_state(state["torch"])

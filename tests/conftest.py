@@ -1,0 +1,44 @@
+"""Test harness: simulate an 8-device TPU slice on CPU.
+
+This is the analog of the reference's debug_launcher/gloo-on-localhost
+strategy (SURVEY §4): `--xla_force_host_platform_device_count=8` gives a real
+8-device mesh so every sharding/collective path runs for real, single-process.
+
+XLA reads these settings at *backend initialization* (first device query), so
+this works even if a pytest plugin imported jax already — as long as no
+backend is live yet.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge
+
+    assert not xla_bridge.backends_are_initialized(), (
+        "JAX backend initialized before conftest could force the 8-device CPU sim"
+    )
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_state():
+    """Reset all runtime singletons between tests (reference
+    AccelerateTestCase, test_utils/testing.py:478-489)."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    PartialState._reset_state()
+    GradientState._reset_state()

@@ -1,0 +1,86 @@
+"""PartialState/AcceleratorState/GradientState unit tests (parity with
+reference tests/test_state_checkpointing.py + test_utils/scripts/test_script.py
+process-control checks)."""
+
+import jax
+import pytest
+
+from accelerate_tpu import AcceleratorState, DistributedType, GradientState, PartialState, ShardingConfig
+
+
+def test_partial_state_singleton():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+    assert a.num_processes == 1
+    assert a.is_main_process
+    assert a.num_devices == 8
+    assert a.distributed_type == DistributedType.CPU_SIM
+
+
+def test_wait_for_everyone_runs():
+    PartialState().wait_for_everyone()
+
+
+def test_split_between_processes_single():
+    state = PartialState()
+    with state.split_between_processes([1, 2, 3]) as x:
+        assert x == [1, 2, 3]
+
+
+def test_on_main_process_decorator():
+    state = PartialState()
+    calls = []
+
+    @state.on_main_process
+    def f():
+        calls.append(1)
+
+    f()
+    assert calls == [1]
+
+
+def test_accelerator_state_mesh_default():
+    state = AcceleratorState()
+    # default: all devices on the data axis
+    assert state.mesh_shape["data"] == 8
+    assert state.mesh_shape["tensor"] == 1
+    assert state.mixed_precision == "no"
+
+
+def test_accelerator_state_custom_mesh():
+    state = AcceleratorState(sharding_config=ShardingConfig(data_parallel=2, tensor_parallel=4))
+    assert state.mesh_shape["data"] == 2
+    assert state.mesh_shape["tensor"] == 4
+
+
+def test_accelerator_state_fsdp_strategy_absorbs():
+    state = AcceleratorState(sharding_config=ShardingConfig(strategy="FSDP"))
+    assert state.mesh_shape["fsdp"] == 8
+    assert state.mesh_shape["data"] == 1
+
+
+def test_mismatched_mesh_raises():
+    with pytest.raises(ValueError):
+        ShardingConfig(data_parallel=3, tensor_parallel=4).resolve(8)
+
+
+def test_gradient_state_defaults():
+    gs = GradientState()
+    assert gs.sync_gradients
+    assert gs.num_steps == 1
+    assert not gs.end_of_dataloader
+    assert gs.remainder == -1
+
+
+def test_state_reset_allows_reinit():
+    AcceleratorState(mixed_precision="bf16")
+    assert AcceleratorState().mixed_precision == "bf16"
+    AcceleratorState._reset_state(reset_partial_state=True)
+    assert AcceleratorState(mixed_precision="no").mixed_precision == "no"
+
+
+def test_second_init_conflicting_precision_raises():
+    AcceleratorState(mixed_precision="bf16")
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="fp16")
