@@ -1,0 +1,1241 @@
+"""The Accelerator façade + TrainEngine (the jit-fused training core).
+
+Parity target: /root/reference/src/accelerate/accelerator.py (3,562 LoC).
+The reference keeps the torch eager loop and interposes wrappers (DDP, AMP
+autocast, GradScaler). Here the same *user loop shape*
+
+    model, optimizer, dataloader, scheduler = accelerator.prepare(...)
+    for batch in dataloader:
+        with accelerator.accumulate(model):
+            outputs = model(**batch)
+            accelerator.backward(outputs["loss"])
+            optimizer.step(); scheduler.step(); optimizer.zero_grad()
+
+is executed by staging onto XLA:
+
+- ``model(**batch)`` runs ONE fused jit computing outputs AND gradients
+  (grads stashed for the coming ``backward``) — same FLOPs as torch's
+  fwd+bwd, no eager/grad-tape machinery;
+- ``backward`` folds the stashed grads into the accumulation buffer
+  (scaled 1/num_steps — the reference divides the loss instead,
+  accelerator.py:2186);
+- ``optimizer.step()`` applies one fused optax update (grad-clip + fp16
+  loss-scale handling via lax.cond inside the jit);
+- data-parallel gradient reduction is IMPLICIT: params are replicated /
+  sharded over the mesh and the batch is sharded on dim0, so XLA inserts
+  the psum over ICI — there is no DDP bucket machinery to configure.
+
+For peak performance `accelerator.build_train_step(loss_fn)` fuses the whole
+micro-batch loop (lax.scan) + update into a single XLA computation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import math
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .data import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches as _skip_first_batches
+from .logging import get_logger
+from .optimizer import AcceleratedOptimizer
+from .parallel.sharding import (
+    batch_spec,
+    infer_param_sharding,
+    replicate,
+    shard_params,
+    sharding_of,
+    unbox_params,
+)
+from .scheduler import AcceleratedScheduler
+from .state import AcceleratorState, GradientState, PartialState
+from .utils.dataclasses import (
+    AutocastKwargs,
+    CompilePlugin,
+    DataLoaderConfiguration,
+    GradScalerKwargs,
+    GradientAccumulationPlugin,
+    InitProcessGroupKwargs,
+    KwargsHandler,
+    MixedPrecisionConfig,
+    PrecisionType,
+    ProfileKwargs,
+    ProjectConfiguration,
+    ShardingConfig,
+)
+from .utils.operations import (
+    convert_outputs_to_fp32,
+    convert_to_fp32,
+    gather,
+    gather_object,
+    pad_across_processes,
+    recursively_apply,
+    reduce,
+    send_to_device,
+)
+from .utils.random import default_keychain
+
+logger = get_logger(__name__)
+
+
+def _is_flax_module(obj) -> bool:
+    try:
+        import flax.linen as nn
+
+        return isinstance(obj, nn.Module)
+    except Exception:
+        return False
+
+
+def _default_loss_selector(outputs):
+    """Find the scalar loss in model outputs (dict['loss'] / .loss / scalar /
+    first element of a tuple)."""
+    if isinstance(outputs, jax.Array) and outputs.ndim == 0:
+        return outputs
+    if isinstance(outputs, dict) and "loss" in outputs:
+        return outputs["loss"]
+    if hasattr(outputs, "loss"):
+        return outputs.loss
+    if isinstance(outputs, (tuple, list)) and len(outputs) > 0:
+        return outputs[0]
+    raise ValueError(
+        "Could not locate a scalar loss in the model outputs; return a dict "
+        "with a 'loss' key (or a scalar), or pass loss_fn= to prepare()."
+    )
+
+
+class Model:
+    """Bundles a model definition with its variables — the unit `prepare()`
+    accepts (torch modules carry params internally; JAX separates them).
+
+    ``definition`` is either a flax linen Module or a pure
+    ``apply(params, *args, **kwargs)`` callable. ``variables`` for flax is
+    the full variables dict ({'params': ..., possibly 'batch_stats': ...});
+    for a callable it is the params pytree itself.
+    """
+
+    def __init__(self, definition, variables, loss_fn: Optional[Callable] = None):
+        self.definition = definition
+        self.is_flax = _is_flax_module(definition)
+        if self.is_flax and not (isinstance(variables, dict) and "params" in variables):
+            variables = {"params": variables}
+        self.variables = variables
+        self.loss_fn = loss_fn
+
+    @property
+    def params(self):
+        return self.variables["params"] if self.is_flax else self.variables
+
+    @property
+    def extra_collections(self) -> dict:
+        if not self.is_flax:
+            return {}
+        return {k: v for k, v in self.variables.items() if k != "params"}
+
+
+class PreparedModel:
+    """What `prepare(model)` returns: callable like the original, running the
+    fused forward(+grad) jit. ``train()``/``eval()`` toggle gradient
+    computation and mutable-state updates (torch-parity)."""
+
+    def __init__(self, engine: "TrainEngine"):
+        self._engine = engine
+        self.training = True
+
+    def __call__(self, *args, **kwargs):
+        return self._engine.model_call(self.training, *args, **kwargs)
+
+    def train(self, mode: bool = True):
+        self.training = mode
+        return self
+
+    def eval(self):
+        self.training = False
+        return self
+
+    @property
+    def params(self):
+        return self._engine.params
+
+    @property
+    def variables(self):
+        return self._engine.current_variables()
+
+    def state_dict(self):
+        return self._engine.current_variables()
+
+    def unwrap(self) -> Model:
+        m = Model(self._engine.model.definition, self._engine.current_variables(),
+                  loss_fn=self._engine.model.loss_fn)
+        return m
+
+
+def _make_scale_state(kwargs: GradScalerKwargs) -> dict:
+    """Dynamic loss scale (GradScaler analog) as a device pytree."""
+    return {
+        "scale": jnp.asarray(kwargs.init_scale, jnp.float32),
+        "growth_tracker": jnp.asarray(0, jnp.int32),
+    }
+
+
+class TrainEngine:
+    """Owns the device state (params/opt_state/accum grads/loss scale) and
+    the jitted computations for one model+optimizer pair."""
+
+    def __init__(
+        self,
+        model: Model,
+        accelerator: "Accelerator",
+    ):
+        self.model = model
+        self.accelerator = accelerator
+        self.state = accelerator.state
+        self.mesh = accelerator.state.mesh
+        self.precision: MixedPrecisionConfig = accelerator.state.precision
+        self.sharding_config: ShardingConfig = accelerator.state.sharding_config
+        self.gradient_state = accelerator.gradient_state
+
+        # --- shard parameters over the mesh (the FSDP/DDP-wrap analog) ---
+        raw_params, logical_axes = unbox_params(model.params)
+        self.param_sharding = infer_param_sharding(
+            raw_params, self.mesh, self.sharding_config, logical_axes
+        )
+        with jax.transfer_guard("allow"):
+            self.params = shard_params(
+                jax.tree_util.tree_map(
+                    lambda p: jnp.asarray(p, self.precision.param_dtype)
+                    if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
+                    else jnp.asarray(p),
+                    raw_params,
+                ),
+                self.param_sharding,
+            )
+        self.extra_state = replicate(model.extra_collections, self.mesh) if model.extra_collections else {}
+
+        self.optimizer: Optional[optax.GradientTransformation] = None
+        self.opt_state = None
+        self.schedule: Optional[Callable] = None
+        self.step_count = 0
+        self._accum_grads = None
+        self._accum_finite = None
+        self._pending_grads = None
+        self._pending_loss = None
+        self._last_skipped = False
+        self._clip_max_norm = None
+        self.scale_state = (
+            _make_scale_state(self.precision.grad_scaler)
+            if self.precision.needs_loss_scaling
+            else None
+        )
+        self.loss_fn = model.loss_fn or _default_loss_selector
+        self._jit_cache: dict = {}
+        self.donate_state = accelerator.compile_plugin.donate_state
+
+    # ------------------------------------------------------------------
+    # model apply plumbing
+    # ------------------------------------------------------------------
+
+    def _apply(self, params, extra_state, training: bool, rng_key, args, kwargs):
+        """Pure forward: returns (outputs, new_extra_state)."""
+        if self.model.is_flax:
+            variables = {"params": params, **extra_state}
+            mutable = list(extra_state.keys()) if (training and extra_state) else False
+            rngs = {"dropout": rng_key} if (training and rng_key is not None) else None
+            out = self.model.definition.apply(
+                variables, *args, rngs=rngs, mutable=mutable, **kwargs
+            )
+            if mutable:
+                outputs, new_state = out
+                return outputs, new_state
+            return out, extra_state
+        else:
+            return self.model.definition(params, *args, **kwargs), extra_state
+
+    def _cast_params(self, params):
+        c = self.precision.compute_dtype
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(c) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
+        )
+
+    # ------------------------------------------------------------------
+    # staged computations
+    # ------------------------------------------------------------------
+
+    def _fwd_bwd_fn(self, params, extra_state, scale, rng_key, args, kwargs):
+        """outputs + grads in one computation (see module docstring)."""
+
+        def loss_of(p):
+            outputs, new_state = self._apply(
+                self._cast_params(p), extra_state, True, rng_key, args, kwargs
+            )
+            loss = self.loss_fn(outputs)
+            loss = loss.astype(jnp.float32)
+            scaled = loss * scale if scale is not None else loss
+            return scaled, (outputs, new_state, loss)
+
+        grads, (outputs, new_state, loss) = jax.grad(loss_of, has_aux=True)(params)
+        if scale is not None:
+            grads = jax.tree_util.tree_map(lambda g: (g / scale).astype(jnp.float32), grads)
+            finite = jnp.all(
+                jnp.asarray(
+                    [jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)]
+                )
+            )
+        else:
+            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+            finite = jnp.asarray(True)
+        outputs = _cast_float_outputs(outputs, self.precision.output_dtype)
+        return outputs, new_state, grads, finite, loss
+
+    def _get_jit(self, name: str, fn, **jit_kwargs):
+        if name not in self._jit_cache:
+            self._jit_cache[name] = jax.jit(fn, **jit_kwargs)
+        return self._jit_cache[name]
+
+    def model_call(self, training: bool, *args, **kwargs):
+        if not training:
+            fwd = self._get_jit(
+                "eval_fwd",
+                lambda p, es, a, kw: _cast_float_outputs(
+                    self._apply(self._cast_params(p), es, False, None, a, kw)[0],
+                    self.precision.output_dtype,
+                ),
+                static_argnames=(),
+            )
+            return fwd(self.params, self.extra_state, args, dict(kwargs))
+
+        rng_key = default_keychain().next_key("dropout")
+        scale = self.scale_state["scale"] if self.scale_state is not None else None
+
+        fwd_bwd = self._get_jit(
+            "fwd_bwd",
+            lambda p, es, s, k, a, kw: self._fwd_bwd_fn(p, es, s, k, a, kw),
+        )
+        outputs, new_state, grads, finite, loss = fwd_bwd(
+            self.params, self.extra_state, scale, rng_key, args, dict(kwargs)
+        )
+        self.extra_state = new_state
+        self._pending_grads = (grads, finite)
+        self._pending_loss = loss
+        return outputs
+
+    def backward(self, loss=None):
+        """Fold pending grads into the accumulation buffer."""
+        if self._pending_grads is None:
+            raise RuntimeError(
+                "accelerator.backward() called but no forward pass is pending. "
+                "Call the prepared model first (in train mode)."
+            )
+        grads, finite = self._pending_grads
+        self._pending_grads = None
+        # inv_steps is a traced argument (not a closure constant) so changing
+        # accelerator.gradient_accumulation_steps mid-run takes effect.
+        inv_steps = jnp.asarray(1.0 / self.gradient_state.num_steps, jnp.float32)
+        if self._accum_grads is None:
+            scale_fn = self._get_jit(
+                "accum_init", lambda g, inv: jax.tree_util.tree_map(lambda x: x * inv, g)
+            )
+            self._accum_grads = scale_fn(grads, inv_steps)
+            self._accum_finite = finite
+        else:
+            add_fn = self._get_jit(
+                "accum_add",
+                lambda acc, g, inv, f_acc, f: (
+                    jax.tree_util.tree_map(lambda a, x: a + x * inv, acc, g),
+                    jnp.logical_and(f_acc, f),
+                ),
+                donate_argnums=(0,),
+            )
+            self._accum_grads, self._accum_finite = add_fn(
+                self._accum_grads, grads, inv_steps, self._accum_finite, finite
+            )
+
+    # ------------------------------------------------------------------
+    # optimizer wiring
+    # ------------------------------------------------------------------
+
+    def attach_optimizer(self, optimizer: optax.GradientTransformation, schedule=None):
+        from .parallel.sharding import infer_opt_state_sharding
+
+        self.optimizer = optimizer
+        self.schedule = schedule
+        self.opt_state_sharding = infer_opt_state_sharding(
+            optimizer, self.params, self.param_sharding, self.mesh
+        )
+        init = self._get_jit(
+            "opt_init", lambda p: optimizer.init(p), out_shardings=self.opt_state_sharding
+        )
+        self.opt_state = init(self.params)
+
+    def _update_fn(self, params, opt_state, grads, scale_state, finite, max_norm):
+        """One optimizer update: clip -> optax -> apply; fp16 skip via cond."""
+        if max_norm is not None:
+            gnorm = optax.global_norm(grads)
+            clip_scale = jnp.minimum(1.0, max_norm / (gnorm + 1e-6))
+            grads = jax.tree_util.tree_map(lambda g: g * clip_scale, grads)
+
+        def do_update(operand):
+            params, opt_state, grads = operand
+            updates, new_opt = self.optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt
+
+        if scale_state is None:
+            new_params, new_opt = do_update((params, opt_state, grads))
+            return new_params, new_opt, None, jnp.asarray(False)
+
+        gk = self.precision.grad_scaler
+
+        def skip(operand):
+            params, opt_state, grads = operand
+            return params, opt_state
+
+        new_params, new_opt = jax.lax.cond(
+            finite, do_update, skip, (params, opt_state, grads)
+        )
+        new_scale = jax.lax.cond(
+            finite,
+            lambda s: {
+                "scale": jnp.where(
+                    s["growth_tracker"] + 1 >= gk.growth_interval,
+                    s["scale"] * gk.growth_factor,
+                    s["scale"],
+                ),
+                "growth_tracker": jnp.where(
+                    s["growth_tracker"] + 1 >= gk.growth_interval,
+                    0,
+                    s["growth_tracker"] + 1,
+                ),
+            },
+            lambda s: {
+                "scale": jnp.maximum(s["scale"] * gk.backoff_factor, 1.0),
+                "growth_tracker": jnp.zeros((), jnp.int32),
+            },
+            scale_state,
+        )
+        return new_params, new_opt, new_scale, jnp.logical_not(finite)
+
+    def optimizer_step(self):
+        if self.optimizer is None:
+            raise RuntimeError("optimizer not attached; prepare(model, optimizer) together")
+        if self._accum_grads is None:
+            logger.warning("optimizer.step() called with no accumulated gradients; skipping")
+            return
+        max_norm = self._clip_max_norm
+        use_clip = max_norm is not None
+        key = "update_clip" if use_clip else "update"
+        if key not in self._jit_cache:
+            if use_clip:
+                fn = lambda p, o, g, s, f, mn: self._update_fn(p, o, g, s, f, mn)
+            else:
+                fn = lambda p, o, g, s, f: self._update_fn(p, o, g, s, f, None)
+            self._jit_cache[key] = jax.jit(
+                fn, donate_argnums=(0, 1, 2) if self.donate_state else (2,)
+            )
+        finite = self._accum_finite if self._accum_finite is not None else jnp.asarray(True)
+        call_args = [self.params, self.opt_state, self._accum_grads, self.scale_state, finite]
+        if use_clip:
+            call_args.append(jnp.asarray(max_norm, jnp.float32))
+        new_params, new_opt, new_scale, skipped = self._jit_cache[key](*call_args)
+        self.params = new_params
+        self.opt_state = new_opt
+        if self.scale_state is not None:
+            self.scale_state = new_scale
+            self._last_skipped = skipped
+        else:
+            self._last_skipped = False
+        self._accum_grads = None
+        self._accum_finite = None
+        self.step_count += 1
+
+    def last_step_skipped(self) -> bool:
+        if isinstance(self._last_skipped, bool):
+            return self._last_skipped
+        return bool(jax.device_get(self._last_skipped))
+
+    def zero_grad(self):
+        self._accum_grads = None
+        self._accum_finite = None
+
+    def clip_grad_norm(self, max_norm: float):
+        """Record the clip threshold for the coming update and return the
+        current global grad norm (reference clip_grad_norm_ returns it)."""
+        self._clip_max_norm = float(max_norm)
+        if self._accum_grads is None:
+            return jnp.asarray(0.0)
+        norm_fn = self._get_jit("grad_norm", optax.global_norm)
+        return norm_fn(self._accum_grads)
+
+    def current_learning_rate(self):
+        if self.schedule is not None:
+            return float(self.schedule(self.step_count))
+        # try to find a scalar lr hyperparam in the opt state
+        try:
+            hp = getattr(self.opt_state, "hyperparams", None)
+            if hp and "learning_rate" in hp:
+                return float(jax.device_get(hp["learning_rate"]))
+        except Exception:
+            pass
+        return None
+
+    def current_variables(self):
+        if self.model.is_flax:
+            return {"params": self.params, **self.extra_state}
+        return self.params
+
+    # ------------------------------------------------------------------
+    # checkpointing
+    # ------------------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        out = {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "step_count": self.step_count,
+        }
+        if self.extra_state:
+            out["extra_state"] = self.extra_state
+        if self.scale_state is not None:
+            out["scale"] = dict(self.scale_state)
+        return out
+
+    def load_state_dict(self, state: dict):
+        self.params = jax.tree_util.tree_map(
+            lambda like, v: jax.device_put(jnp.asarray(v, like.dtype), like.sharding),
+            self.params, state["params"],
+        )
+        if self.opt_state is not None and state.get("opt_state") is not None:
+            self.opt_state = jax.tree_util.tree_map(
+                lambda like, v: jax.device_put(jnp.asarray(v, like.dtype), like.sharding)
+                if isinstance(like, jax.Array)
+                else v,
+                self.opt_state, state["opt_state"],
+            )
+        self.step_count = int(state.get("step_count", 0))
+        if "extra_state" in state:
+            self.extra_state = replicate(state["extra_state"], self.mesh)
+        if "scale" in state and self.scale_state is not None:
+            self.scale_state = {
+                "scale": jnp.asarray(state["scale"]["scale"], jnp.float32),
+                "growth_tracker": jnp.asarray(state["scale"]["growth_tracker"], jnp.int32),
+            }
+
+    def load_optimizer_state(self, state: dict):
+        if state.get("opt_state") is not None and self.opt_state is not None:
+            self.opt_state = jax.tree_util.tree_map(
+                lambda like, v: jax.device_put(jnp.asarray(v, like.dtype), like.sharding)
+                if isinstance(like, jax.Array)
+                else v,
+                self.opt_state, state["opt_state"],
+            )
+        if "step_count" in state:
+            self.step_count = int(state["step_count"])
+
+    # ------------------------------------------------------------------
+    # fully-fused train step (the perf path)
+    # ------------------------------------------------------------------
+
+    def build_train_step(self, loss_fn: Optional[Callable] = None, micro_steps: Optional[int] = None):
+        """One jit: split batch into micro-batches, lax.scan fwd+bwd
+        accumulating grads, clip, update. Returns step(batch)->metrics."""
+        micro = micro_steps or self.gradient_state.num_steps
+        user_loss = loss_fn
+        max_norm = self._clip_max_norm
+
+        def loss_and_state(params, extra_state, rng_key, batch):
+            """-> (loss, new_extra_state). user_loss path can't update
+            mutable collections (no handle to return them) — documented."""
+            if user_loss is not None:
+                return (
+                    user_loss(self._make_apply(extra_state, rng_key), params, batch),
+                    extra_state,
+                )
+            args, kwargs = _batch_to_call(batch)
+            outputs, new_state = self._apply(
+                self._cast_params(params), extra_state, True, rng_key, args, kwargs
+            )
+            return self.loss_fn(outputs).astype(jnp.float32), new_state
+
+        def step_fn(params, opt_state, extra_state, scale_state, rng_key, batch):
+            scale = scale_state["scale"] if scale_state is not None else None
+
+            def one_micro(carry, mb):
+                acc, loss_acc, key, es = carry
+                key, sub = jax.random.split(key)
+
+                def scaled_loss(p):
+                    l, new_es = loss_and_state(p, es, sub, mb)
+                    return (l * scale if scale is not None else l), (l, new_es)
+
+                g, (l, new_es) = jax.grad(scaled_loss, has_aux=True)(params)
+                acc = jax.tree_util.tree_map(
+                    lambda a, x: a + x.astype(jnp.float32) / micro, acc, g
+                )
+                return (acc, loss_acc + l / micro, key, new_es), None
+
+            zero = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            carry0 = (zero, jnp.asarray(0.0), rng_key, extra_state)
+            if micro > 1:
+                mbs = jax.tree_util.tree_map(
+                    lambda x: x.reshape((micro, x.shape[0] // micro) + x.shape[1:]), batch
+                )
+                (grads, loss, _, new_extra), _ = jax.lax.scan(one_micro, carry0, mbs)
+            else:
+                (grads, loss, _, new_extra), _ = one_micro(carry0, batch)
+            if scale is not None:
+                grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+                finite = jnp.all(
+                    jnp.asarray([jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)])
+                )
+            else:
+                finite = jnp.asarray(True)
+            new_params, new_opt, new_scale, skipped = self._update_fn(
+                params, opt_state, grads, scale_state, finite,
+                jnp.asarray(max_norm, jnp.float32) if max_norm is not None else None,
+            )
+            metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+            return new_params, new_opt, new_extra, new_scale, skipped, metrics
+
+        jitted = jax.jit(step_fn, donate_argnums=(0, 1) if self.donate_state else ())
+
+        def run(batch):
+            rng_key = default_keychain().next_key("train_step")
+            new_params, new_opt, new_extra, new_scale, skipped, metrics = jitted(
+                self.params, self.opt_state, self.extra_state, self.scale_state, rng_key, batch
+            )
+            self.params, self.opt_state = new_params, new_opt
+            self.extra_state = new_extra
+            if self.scale_state is not None:
+                self.scale_state = new_scale
+                self._last_skipped = skipped
+            self.step_count += 1
+            return metrics
+
+        return run
+
+    def _make_apply(self, extra_state, rng_key):
+        def apply_fn(params, *args, **kwargs):
+            out, _ = self._apply(self._cast_params(params), extra_state, True, rng_key, args, kwargs)
+            return out
+
+        return apply_fn
+
+
+def _cast_float_outputs(outputs, dtype):
+    return recursively_apply(
+        lambda t: t.astype(dtype) if jnp.issubdtype(t.dtype, jnp.floating) else t, outputs
+    )
+
+
+def _batch_to_call(batch):
+    if isinstance(batch, dict):
+        return (), batch
+    if isinstance(batch, (tuple, list)):
+        return tuple(batch), {}
+    return (batch,), {}
+
+
+class Accelerator:
+    """The user façade (reference accelerator.py:160)."""
+
+    def __init__(
+        self,
+        device_placement: bool = True,
+        split_batches: bool = False,
+        mixed_precision: Optional[str] = None,
+        gradient_accumulation_steps: int = 1,
+        cpu: bool = False,
+        dataloader_config: Optional[DataLoaderConfiguration] = None,
+        log_with=None,
+        project_dir: Optional[str] = None,
+        project_config: Optional[ProjectConfiguration] = None,
+        gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None,
+        sharding_config: Optional[ShardingConfig] = None,
+        compile_plugin: Optional[CompilePlugin] = None,
+        step_scheduler_with_optimizer: bool = True,
+        kwargs_handlers: Optional[list] = None,
+        rng_types: Optional[list] = None,
+        loss_fn: Optional[Callable] = None,
+    ):
+        self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
+        if project_dir is not None and self.project_configuration.project_dir is None:
+            self.project_configuration.set_directories(project_dir)
+
+        # kwargs handlers (reference accelerator.py:347-381)
+        self.scaler_handler = None
+        self.init_handler = None
+        self.autocast_handler = None
+        self.profile_handler = None
+        for handler in kwargs_handlers or []:
+            if isinstance(handler, GradScalerKwargs):
+                self.scaler_handler = handler
+            elif isinstance(handler, InitProcessGroupKwargs):
+                self.init_handler = handler
+            elif isinstance(handler, AutocastKwargs):
+                self.autocast_handler = handler
+            elif isinstance(handler, ProfileKwargs):
+                self.profile_handler = handler
+
+        self.compile_plugin = compile_plugin or CompilePlugin()
+        self.compile_plugin.apply_cache()
+
+        self.state = AcceleratorState(
+            mixed_precision=mixed_precision,
+            cpu=cpu,
+            sharding_config=sharding_config,
+            _from_accelerator=True,
+        )
+        if self.scaler_handler is not None:
+            self.state.precision.grad_scaler = self.scaler_handler
+
+        if gradient_accumulation_plugin is None:
+            gradient_accumulation_plugin = GradientAccumulationPlugin(
+                num_steps=int(os.environ.get("ACCELERATE_TPU_GRADIENT_ACCUMULATION_STEPS",
+                                             gradient_accumulation_steps))
+            )
+        self.gradient_state = GradientState(gradient_accumulation_plugin=gradient_accumulation_plugin)
+
+        self.dataloader_config = dataloader_config or DataLoaderConfiguration(split_batches=split_batches)
+        self.device_placement = device_placement
+        self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
+        self.rng_types = rng_types or ["jax"]
+        self.loss_fn = loss_fn
+
+        self._engines: list[TrainEngine] = []
+        self._models: list[PreparedModel] = []
+        self._optimizers: list[AcceleratedOptimizer] = []
+        self._schedulers: list[AcceleratedScheduler] = []
+        self._dataloaders: list = []
+        self._custom_objects: list = []
+        self._load_model_state_pre_hook = {}
+        self._save_model_state_pre_hook = {}
+        self.step = 0
+        self.flag_tensor = None
+
+        from .tracking import filter_trackers
+
+        self.log_with = filter_trackers(log_with, self.logging_dir)
+        self.trackers: list = []
+
+    # ------------------------------------------------------------------
+    # state passthroughs (reference accelerator.py properties)
+    # ------------------------------------------------------------------
+
+    @property
+    def distributed_type(self):
+        return self.state.distributed_type
+
+    @property
+    def num_processes(self):
+        return self.state.num_processes
+
+    @property
+    def process_index(self):
+        return self.state.process_index
+
+    @property
+    def local_process_index(self):
+        return self.state.local_process_index
+
+    @property
+    def device(self):
+        return self.state.device
+
+    @property
+    def mesh(self):
+        return self.state.mesh
+
+    @property
+    def is_main_process(self):
+        return self.state.is_main_process
+
+    @property
+    def is_local_main_process(self):
+        return self.state.is_local_main_process
+
+    @property
+    def is_last_process(self):
+        return self.state.is_last_process
+
+    @property
+    def mixed_precision(self):
+        return self.state.mixed_precision
+
+    @property
+    def project_dir(self):
+        return self.project_configuration.project_dir
+
+    @property
+    def logging_dir(self):
+        return self.project_configuration.logging_dir
+
+    @property
+    def save_iteration(self):
+        return self.project_configuration.iteration
+
+    @property
+    def sync_gradients(self):
+        return self.gradient_state.sync_gradients
+
+    @property
+    def gradient_accumulation_steps(self):
+        return self.gradient_state.num_steps
+
+    @gradient_accumulation_steps.setter
+    def gradient_accumulation_steps(self, value):
+        self.gradient_state.plugin_kwargs.update({"num_steps": value})
+
+    @property
+    def optimizer_step_was_skipped(self):
+        return any(opt.step_was_skipped for opt in self._optimizers)
+
+    def on_main_process(self, function):
+        return self.state.on_main_process(function)
+
+    def on_local_main_process(self, function):
+        return self.state.on_local_main_process(function)
+
+    def on_process(self, function=None, process_index=None):
+        return self.state.on_process(function, process_index)
+
+    def on_last_process(self, function):
+        return self.state.on_last_process(function)
+
+    def wait_for_everyone(self):
+        self.state.wait_for_everyone()
+
+    @contextlib.contextmanager
+    def main_process_first(self):
+        with self.state.main_process_first():
+            yield
+
+    @contextlib.contextmanager
+    def local_main_process_first(self):
+        with self.state.local_main_process_first():
+            yield
+
+    def split_between_processes(self, inputs, apply_padding=False):
+        return self.state.split_between_processes(inputs, apply_padding=apply_padding)
+
+    def print(self, *args, **kwargs):
+        self.state.print(*args, **kwargs)
+
+    # ------------------------------------------------------------------
+    # prepare (reference accelerator.py:1211)
+    # ------------------------------------------------------------------
+
+    def prepare(self, *args, device_placement=None):
+        """Dispatch each object to its _prepare_* (two-pass like the
+        reference: models first so optimizers can attach to engines)."""
+        result = list(args)
+        # pass 1: models
+        for i, obj in enumerate(result):
+            if isinstance(obj, Model) or _is_flax_module(obj):
+                result[i] = self.prepare_model(obj)
+        # pass 2: everything else
+        for i, obj in enumerate(result):
+            if isinstance(obj, optax.GradientTransformation):
+                result[i] = self.prepare_optimizer(obj)
+            elif _is_dataloader_like(obj):
+                result[i] = self.prepare_data_loader(obj)
+        # pass 3: schedules (need prepared optimizers)
+        for i, obj in enumerate(result):
+            if callable(obj) and not isinstance(
+                obj, (PreparedModel, AcceleratedOptimizer, Model)
+            ) and not _is_dataloader_like(obj) and not isinstance(obj, optax.GradientTransformation):
+                result[i] = self.prepare_scheduler(obj)
+        return result[0] if len(result) == 1 else tuple(result)
+
+    def prepare_model(self, model: Union[Model, Any], device_placement=None, evaluation_mode=False) -> PreparedModel:
+        if _is_flax_module(model):
+            raise ValueError(
+                "Pass `accelerate_tpu.Model(flax_module, variables)` so prepare() "
+                "has the parameters (JAX separates module and params)."
+            )
+        if model.loss_fn is None and self.loss_fn is not None:
+            model.loss_fn = self.loss_fn
+        engine = TrainEngine(model, self)
+        self._engines.append(engine)
+        prepared = PreparedModel(engine)
+        if evaluation_mode:
+            prepared.eval()
+        self._models.append(prepared)
+        return prepared
+
+    def prepare_optimizer(self, optimizer: optax.GradientTransformation, device_placement=None) -> AcceleratedOptimizer:
+        engine = self._engines[len(self._optimizers)] if len(self._engines) > len(self._optimizers) else (
+            self._engines[-1] if self._engines else None
+        )
+        wrapped = AcceleratedOptimizer(optimizer, engine=engine)
+        if engine is not None:
+            engine.attach_optimizer(optimizer)
+        self._optimizers.append(wrapped)
+        return wrapped
+
+    def prepare_scheduler(self, schedule: Callable) -> AcceleratedScheduler:
+        wrapped = AcceleratedScheduler(
+            schedule,
+            optimizers=self._optimizers,
+            split_batches=self.dataloader_config.split_batches,
+            step_with_optimizer=self.step_scheduler_with_optimizer,
+        )
+        for engine in self._engines:
+            if engine.schedule is None:
+                engine.schedule = schedule
+        self._schedulers.append(wrapped)
+        return wrapped
+
+    def prepare_data_loader(self, data_loader, device_placement=None, slice_fn_for_dispatch=None):
+        prepared = prepare_data_loader(
+            data_loader,
+            mesh=self.state.mesh if (device_placement if device_placement is not None else self.device_placement) else None,
+            rng_types=self.rng_types,
+            config=self.dataloader_config,
+        )
+        self._dataloaders.append(prepared)
+        return prepared
+
+    # ------------------------------------------------------------------
+    # the training contract
+    # ------------------------------------------------------------------
+
+    def backward(self, loss=None, **kwargs):
+        """Reference accelerator.py:2164. The loss value is informational
+        (grads were computed at the model call); accumulation scaling by
+        1/num_steps happens here like the reference's loss division."""
+        for engine in self._engines:
+            if engine._pending_grads is not None:
+                engine.backward(loss)
+
+    def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: int = 2):
+        """Reference accelerator.py:2292. Returns the global grad norm."""
+        if norm_type != 2:
+            raise ValueError("only L2 grad clipping is supported on TPU")
+        norms = [e.clip_grad_norm(max_norm) for e in self._engines]
+        return norms[0] if len(norms) == 1 else norms
+
+    def clip_grad_value_(self, parameters=None, clip_value: float = 1.0):
+        raise NotImplementedError(
+            "clip_grad_value_ is not supported; use clip_grad_norm_ "
+            "(value clipping breaks GSPMD gradient fusion)."
+        )
+
+    @contextlib.contextmanager
+    def accumulate(self, *models):
+        """Reference accelerator.py:931-1088: toggles sync_gradients based on
+        the step counter / dataloader end."""
+        self._do_sync()
+        yield
+
+    def _do_sync(self):
+        if self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader:
+            self.step = 0
+            self.gradient_state._set_sync_gradients(True)
+        else:
+            self.step += 1
+            self.gradient_state._set_sync_gradients(
+                (self.step % self.gradient_state.num_steps) == 0
+                or self.gradient_state.sync_each_batch
+            )
+
+    @contextlib.contextmanager
+    def no_sync(self, model=None):
+        """Under GSPMD grad reduction happens inside the fused update, so
+        accumulating locally is already communication-free; this context just
+        forces sync_gradients False for parity (reference accelerator.py:994)."""
+        old = self.gradient_state.sync_gradients
+        self.gradient_state._set_sync_gradients(False)
+        try:
+            yield
+        finally:
+            self.gradient_state._set_sync_gradients(old)
+
+    @contextlib.contextmanager
+    def join_uneven_inputs(self, joinables, even_batches=None):
+        """DDP Join parity (reference accelerator.py:1091). With global-batch
+        SPMD feeding every process always sees the same number of batches, so
+        this is a no-op wrapper (even_batches override included for parity)."""
+        if even_batches is not None:
+            for dl in self._dataloaders:
+                dl.even_batches = even_batches
+        yield
+
+    @contextlib.contextmanager
+    def autocast(self, autocast_handler: Optional[AutocastKwargs] = None):
+        """Parity context (reference accelerator.py:3386): precision is a
+        property of the staged computation, so nothing to switch here."""
+        yield
+
+    def build_train_step(self, loss_fn: Optional[Callable] = None, micro_steps: Optional[int] = None):
+        """The fused-perf path: one XLA computation for the whole optimizer
+        step (micro-batch scan + clip + update). Idiomatic-JAX users should
+        prefer this over the eager-parity loop."""
+        if not self._engines:
+            raise RuntimeError("prepare(model, optimizer) before build_train_step")
+        return self._engines[-1].build_train_step(loss_fn=loss_fn, micro_steps=micro_steps)
+
+    # ------------------------------------------------------------------
+    # collectives façade (reference accelerator.py:2408-2608)
+    # ------------------------------------------------------------------
+
+    def gather(self, tensor):
+        return gather(tensor)
+
+    def gather_for_metrics(self, input_data, use_gather_object: bool = False):
+        """Gather + drop the tail samples duplicated by even_batches padding
+        (reference accelerator.py:2408-2480, driven by GradientState.remainder)."""
+        try:
+            recursively_apply(lambda x: x, input_data, error_on_other_type=True)
+            all_tensors = True
+        except TypeError:
+            all_tensors = False
+        if use_gather_object or not all_tensors:
+            data = gather_object(input_data)
+        else:
+            data = self.gather(input_data)
+        try:
+            if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
+                def _adjust(tensor):
+                    return tensor[: self.gradient_state.remainder]
+
+                return recursively_apply(_adjust, data)
+            return data
+        except Exception:
+            return data
+
+    def reduce(self, tensor, reduction="sum", scale=1.0):
+        return reduce(tensor, reduction, scale)
+
+    def pad_across_processes(self, tensor, dim=0, pad_index=0, pad_first=False):
+        return pad_across_processes(tensor, dim=dim, pad_index=pad_index, pad_first=pad_first)
+
+    def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
+        if isinstance(model, PreparedModel):
+            return model.unwrap()
+        return model
+
+    def prepare_for_eval(self, batch):
+        """Place an eval batch the same way prepared dataloaders do."""
+        from .utils.operations import make_global_batch
+
+        return make_global_batch(batch, self.state.mesh)
+
+    # ------------------------------------------------------------------
+    # trigger (coordinated breakpoint; reference accelerator.py:2198-2255)
+    # ------------------------------------------------------------------
+
+    def set_trigger(self):
+        self.flag_tensor = True
+
+    def check_trigger(self) -> bool:
+        flags = gather_object([1 if self.flag_tensor else 0])
+        if any(flags):
+            self.flag_tensor = False
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # trackers (reference accelerator.py:2610-2737)
+    # ------------------------------------------------------------------
+
+    def init_trackers(self, project_name: str, config: Optional[dict] = None, init_kwargs: dict = {}):
+        from .tracking import resolve_trackers
+
+        self.trackers = resolve_trackers(self.log_with, project_name, self.logging_dir, init_kwargs)
+        if config is not None:
+            for tracker in self.trackers:
+                tracker.store_init_configuration(config)
+
+    def get_tracker(self, name: str, unwrap: bool = False):
+        for tracker in self.trackers:
+            if tracker.name == name:
+                return tracker.tracker if unwrap else tracker
+        from .tracking import GeneralTracker
+
+        return GeneralTracker(_blank=True)
+
+    def log(self, values: dict, step: Optional[int] = None, log_kwargs: dict = {}):
+        for tracker in self.trackers:
+            tracker.log(values, step=step, **log_kwargs.get(tracker.name, {}))
+
+    def end_training(self):
+        for tracker in self.trackers:
+            tracker.finish()
+
+    # ------------------------------------------------------------------
+    # save / load (reference accelerator.py:2739-3218) — checkpointing.py
+    # ------------------------------------------------------------------
+
+    def save(self, obj, f, safe_serialization: bool = True):
+        from .utils.other import save as _save
+
+        _save(obj, f, save_on_each_node=self.project_configuration.save_on_each_node,
+              safe_serialization=safe_serialization)
+
+    def save_model(self, model, save_directory, max_shard_size="10GB", safe_serialization=True):
+        from .checkpointing import save_model_weights
+
+        save_model_weights(model, save_directory, max_shard_size=max_shard_size,
+                           safe_serialization=safe_serialization)
+
+    def register_for_checkpointing(self, *objects):
+        invalid = [obj for obj in objects if not (hasattr(obj, "state_dict") and hasattr(obj, "load_state_dict"))]
+        if invalid:
+            raise ValueError(
+                f"All `objects` must include a `state_dict` and `load_state_dict` function to be stored: {invalid}"
+            )
+        self._custom_objects.extend(objects)
+
+    def register_save_state_pre_hook(self, hook):
+        import uuid
+
+        key = uuid.uuid4()
+        self._save_model_state_pre_hook[key] = hook
+        return _RemovableHandle(self._save_model_state_pre_hook, key)
+
+    def register_load_state_pre_hook(self, hook):
+        import uuid
+
+        key = uuid.uuid4()
+        self._load_model_state_pre_hook[key] = hook
+        return _RemovableHandle(self._load_model_state_pre_hook, key)
+
+    def save_state(self, output_dir: Optional[str] = None, safe_serialization: bool = True, **save_model_func_kwargs):
+        from .checkpointing import save_accelerator_state
+
+        if self.project_configuration.automatic_checkpoint_naming:
+            output_dir = os.path.join(self.project_dir, "checkpoints")
+        os.makedirs(output_dir, exist_ok=True)
+        if self.project_configuration.automatic_checkpoint_naming:
+            folders = [os.path.join(output_dir, folder) for folder in os.listdir(output_dir)]
+            if (
+                self.project_configuration.total_limit is not None
+                and (len(folders) + 1 > self.project_configuration.total_limit)
+                and self.is_main_process
+            ):
+                folders.sort(key=lambda f: int(f.rsplit("_", 1)[-1]) if f.rsplit("_", 1)[-1].isdigit() else -1)
+                for folder in folders[: len(folders) + 1 - self.project_configuration.total_limit]:
+                    import shutil
+
+                    shutil.rmtree(folder, ignore_errors=True)
+            output_dir = os.path.join(output_dir, f"checkpoint_{self.save_iteration}")
+            if os.path.exists(output_dir):
+                raise ValueError(
+                    f"Checkpoint directory {output_dir} ({self.save_iteration}) already "
+                    "exists. Please manually override `self.save_iteration` with what "
+                    "iteration to start with."
+                )
+            self.wait_for_everyone()
+        os.makedirs(output_dir, exist_ok=True)
+        logger.info(f"Saving current state to {output_dir}")
+
+        for hook in self._save_model_state_pre_hook.values():
+            hook(self._models, [], output_dir)
+
+        path = save_accelerator_state(
+            output_dir,
+            engines=self._engines,
+            schedulers=self._schedulers,
+            dataloaders=self._dataloaders,
+            custom_objects=self._custom_objects,
+            step=self.step,
+            safe_serialization=safe_serialization,
+        )
+        self.project_configuration.iteration += 1
+        return path
+
+    def load_state(self, input_dir: Optional[str] = None, **load_model_func_kwargs):
+        from .checkpointing import load_accelerator_state
+
+        if input_dir is None and self.project_configuration.automatic_checkpoint_naming:
+            base = os.path.join(self.project_dir, "checkpoints")
+            folders = sorted(
+                os.listdir(base), key=lambda f: int(f.rsplit("_", 1)[-1]) if f.rsplit("_", 1)[-1].isdigit() else -1
+            )
+            input_dir = os.path.join(base, folders[-1])
+        logger.info(f"Loading states from {input_dir}")
+
+        for hook in self._load_model_state_pre_hook.values():
+            hook(self._models, [], input_dir)
+
+        override_step = load_accelerator_state(
+            input_dir,
+            engines=self._engines,
+            schedulers=self._schedulers,
+            dataloaders=self._dataloaders,
+            custom_objects=self._custom_objects,
+        )
+        if override_step is not None:
+            self.step = override_step
+
+    def get_state_dict(self, model, unwrap=True):
+        """Full (host-replicated) variables of a prepared model — the
+        FSDP FULL_STATE_DICT consolidation analog (reference :3291-3348)."""
+        if isinstance(model, PreparedModel):
+            variables = model.state_dict()
+        elif isinstance(model, Model):
+            variables = model.variables
+        else:
+            variables = model
+        from .utils.serialization import _to_numpy
+
+        return jax.tree_util.tree_map(_to_numpy, variables)
+
+    def skip_first_batches(self, dataloader, num_batches: int = 0):
+        return _skip_first_batches(dataloader, num_batches)
+
+    def free_memory(self, *objects):
+        """Reference :3219. Drops engine/device state references + caches."""
+        from .utils.memory import release_memory
+
+        objects = release_memory(*objects)
+        self._engines.clear()
+        self._models.clear()
+        self._optimizers.clear()
+        self._schedulers.clear()
+        self._dataloaders.clear()
+        self.step = 0
+        jax.clear_caches()
+        return objects
+
+    def clear(self, *objects):
+        return self.free_memory(*objects)
+
+    def profile(self, profile_handler: Optional[ProfileKwargs] = None):
+        handler = profile_handler or self.profile_handler or ProfileKwargs()
+        return handler.build(suffix=str(self.process_index))
+
+    @contextlib.contextmanager
+    def local_sgd(self, *args, **kwargs):  # pragma: no cover - see local_sgd.py
+        from .local_sgd import LocalSGD
+
+        with LocalSGD(self, *args, **kwargs) as ctx:
+            yield ctx
+
+    def __repr__(self):
+        return f"Accelerator(state={self.state!r})"
+
+
+class _RemovableHandle:
+    def __init__(self, registry, key):
+        self.registry = registry
+        self.key = key
+
+    def remove(self):
+        self.registry.pop(self.key, None)
+
+
+def _is_dataloader_like(obj) -> bool:
+    from .data import DataLoader
+
+    if isinstance(obj, (DataLoader, DataLoaderShard, DataLoaderDispatcher)):
+        return True
+    return type(obj).__module__.startswith("torch.utils.data")

@@ -1,0 +1,274 @@
+"""Checkpoint/resume (parity: /root/reference/src/accelerate/checkpointing.py,
+302 LoC + Accelerator.save_state/load_state orchestration :2883-3218).
+
+A checkpoint directory contains:
+  model_<i>.safetensors[.index.json]   engine params (+ extra collections)
+  optimizer_<i>.safetensors            optax state arrays (+ structure pickle)
+  scheduler_<i>.bin                    scheduler counters
+  dl_state_<i>.bin                     dataloader progress (mid-epoch resume)
+  random_states_<rank>.pkl             python/numpy/torch RNG + threefry KeyChain
+  custom_checkpoint_<i>.bin            user-registered objects
+  trainer_state.json                   step counters, loss-scale, iteration
+
+Sharded arrays are gathered per-host into full arrays before writing (every
+value in safetensors is global); `load_*` re-shards on read via each engine's
+recorded shardings. RNG resume reproduces the exact stream because JAX keys
+are counter-based (KeyChain counters are saved, not device state).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.constants import (
+    CUSTOM_STATE_PATTERN,
+    DATALOADER_STATE_NAME,
+    MODEL_NAME,
+    OPTIMIZER_NAME,
+    RNG_STATE_NAME,
+    SCHEDULER_NAME,
+)
+from .utils.random import load_rng_state_dict, rng_state_dict
+from .utils.serialization import (
+    flatten_pytree,
+    load_flat_dict,
+    save_pytree,
+    unflatten_to_like,
+)
+
+logger = get_logger(__name__)
+
+
+def save_accelerator_state(
+    output_dir: str,
+    engines=(),
+    schedulers=(),
+    dataloaders=(),
+    custom_objects=(),
+    step: int = 0,
+    safe_serialization: bool = True,
+):
+    """reference checkpointing.py:52."""
+    state = PartialState()
+    os.makedirs(output_dir, exist_ok=True)
+    ext = "safetensors" if safe_serialization else "bin"
+
+    trainer_state = {"step": step, "engines": []}
+    for i, engine in enumerate(engines):
+        sd = engine.state_dict()
+        # Materialize sharded arrays on EVERY host first: gathering a
+        # non-fully-addressable array is a collective all ranks must join
+        # (writing the file, below, is main-process-only).
+        from .utils.serialization import _to_numpy
+
+        model_tree = {"params": sd["params"]}
+        if "extra_state" in sd:
+            model_tree["extra_state"] = sd["extra_state"]
+        model_tree = jax.tree_util.tree_map(_to_numpy, model_tree)
+        opt_flat = (
+            {k: _to_numpy(v) for k, v in _arrays_only(sd["opt_state"]).items()}
+            if sd.get("opt_state") is not None
+            else None
+        )
+        if state.is_main_process:
+            save_pytree(model_tree, os.path.join(output_dir, f"{MODEL_NAME}_{i}.{ext}"),
+                        safe_serialization=safe_serialization)
+            logger.info(f"Model weights saved in {output_dir}/{MODEL_NAME}_{i}.{ext}")
+            if opt_flat is not None:
+                save_pytree(
+                    opt_flat,
+                    os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}.{ext}"),
+                    safe_serialization=safe_serialization,
+                )
+                logger.info(f"Optimizer state saved in {output_dir}/{OPTIMIZER_NAME}_{i}.{ext}")
+        meta = {"step_count": sd["step_count"]}
+        if "scale" in sd:
+            meta["scale"] = {k: float(np.asarray(jax.device_get(v))) for k, v in sd["scale"].items()}
+        trainer_state["engines"].append(meta)
+
+    if state.is_main_process:
+        for i, sched in enumerate(schedulers):
+            with open(os.path.join(output_dir, f"{SCHEDULER_NAME}_{i}.bin"), "wb") as f:
+                pickle.dump(sched.state_dict(), f)
+        for i, dl in enumerate(dataloaders):
+            if hasattr(dl, "state_dict"):
+                with open(os.path.join(output_dir, f"{DATALOADER_STATE_NAME}_{i}.bin"), "wb") as f:
+                    pickle.dump(dl.state_dict(), f)
+        for i, obj in enumerate(custom_objects):
+            with open(os.path.join(output_dir, CUSTOM_STATE_PATTERN.format(i) + ".bin"), "wb") as f:
+                pickle.dump(obj.state_dict(), f)
+            logger.info(f"Saving the state of {type(obj).__name__} to {output_dir}")
+        with open(os.path.join(output_dir, "trainer_state.json"), "w") as f:
+            json.dump(trainer_state, f, indent=2)
+
+    # per-rank RNG bundle (reference checkpointing.py:145-161)
+    with open(os.path.join(output_dir, f"{RNG_STATE_NAME}_{state.process_index}.pkl"), "wb") as f:
+        pickle.dump(rng_state_dict(), f)
+
+    state.wait_for_everyone()
+    return output_dir
+
+
+def load_accelerator_state(
+    input_dir: str,
+    engines=(),
+    schedulers=(),
+    dataloaders=(),
+    custom_objects=(),
+) -> Optional[int]:
+    """reference checkpointing.py:164. Returns the step override."""
+    state = PartialState()
+    override_step = None
+    trainer_state = {}
+    ts_path = os.path.join(input_dir, "trainer_state.json")
+    if os.path.exists(ts_path):
+        with open(ts_path) as f:
+            trainer_state = json.load(f)
+        override_step = trainer_state.get("step")
+
+    for i, engine in enumerate(engines):
+        model_path = _find(input_dir, f"{MODEL_NAME}_{i}")
+        if model_path:
+            flat = load_flat_dict(model_path)
+            like = {"params": engine.params}
+            if engine.extra_state:
+                like["extra_state"] = engine.extra_state
+            tree = unflatten_to_like(flat, like)
+            sd = {"params": tree["params"], "step_count": 0}
+            if "extra_state" in tree:
+                sd["extra_state"] = tree["extra_state"]
+            opt_path = _find(input_dir, f"{OPTIMIZER_NAME}_{i}")
+            if opt_path and engine.opt_state is not None:
+                opt_flat = load_flat_dict(opt_path)
+                sd["opt_state"] = _merge_arrays(engine.opt_state, opt_flat)
+            meta = (trainer_state.get("engines") or [{}] * (i + 1))[i]
+            sd["step_count"] = meta.get("step_count", 0)
+            if "scale" in meta:
+                sd["scale"] = meta["scale"]
+            engine.load_state_dict(sd)
+            logger.info(f"Loaded model/optimizer state for engine {i}")
+
+    for i, sched in enumerate(schedulers):
+        p = os.path.join(input_dir, f"{SCHEDULER_NAME}_{i}.bin")
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                sched.load_state_dict(pickle.load(f))
+
+    for i, dl in enumerate(dataloaders):
+        p = os.path.join(input_dir, f"{DATALOADER_STATE_NAME}_{i}.bin")
+        if os.path.exists(p) and hasattr(dl, "load_state_dict"):
+            with open(p, "rb") as f:
+                dl.load_state_dict(pickle.load(f))
+
+    for i, obj in enumerate(custom_objects):
+        p = os.path.join(input_dir, CUSTOM_STATE_PATTERN.format(i) + ".bin")
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                obj.load_state_dict(pickle.load(f))
+            logger.info(f"Loaded the state of {type(obj).__name__} from {p}")
+
+    rng_path = os.path.join(input_dir, f"{RNG_STATE_NAME}_{state.process_index}.pkl")
+    if not os.path.exists(rng_path):
+        rng_path = os.path.join(input_dir, f"{RNG_STATE_NAME}_0.pkl")
+    if os.path.exists(rng_path):
+        try:
+            with open(rng_path, "rb") as f:
+                load_rng_state_dict(pickle.load(f))
+            logger.info("All random states loaded successfully")
+        except Exception:
+            logger.info("Could not load random states")
+
+    return override_step
+
+
+def save_custom_state(obj, path: str, index: int = 0, save_on_each_node: bool = False):
+    """reference checkpointing.py:286."""
+    state = PartialState()
+    if state.is_main_process or save_on_each_node:
+        save_location = os.path.join(path, CUSTOM_STATE_PATTERN.format(index) + ".bin")
+        logger.info(f"Saving the state of {type(obj).__name__} to {save_location}")
+        with open(save_location, "wb") as f:
+            pickle.dump(obj.state_dict(), f)
+
+
+def load_custom_state(obj, path: str, index: int = 0):
+    """reference checkpointing.py:295."""
+    load_location = os.path.join(path, CUSTOM_STATE_PATTERN.format(index) + ".bin")
+    logger.info(f"Loading the state of {type(obj).__name__} from {load_location}")
+    with open(load_location, "rb") as f:
+        obj.load_state_dict(pickle.load(f))
+
+
+def save_model_weights(model, save_directory, max_shard_size="10GB", safe_serialization=True):
+    """Standalone weights export (reference Accelerator.save_model
+    :2739-2882): sharded safetensors + index json."""
+    from .accelerator import Model, PreparedModel
+
+    if os.path.isfile(save_directory):
+        logger.error(f"Provided path ({save_directory}) should be a directory, not a file")
+        return
+    os.makedirs(save_directory, exist_ok=True)
+    if isinstance(model, PreparedModel):
+        variables = model.state_dict()
+    elif isinstance(model, Model):
+        variables = model.variables
+    else:
+        variables = model
+    state = PartialState()
+    # collective gather on all ranks; file write on main only
+    from .utils.serialization import _to_numpy
+
+    variables = jax.tree_util.tree_map(_to_numpy, variables)
+    if state.is_main_process:
+        from .utils.constants import SAFE_WEIGHTS_NAME, WEIGHTS_NAME
+
+        name = SAFE_WEIGHTS_NAME if safe_serialization else WEIGHTS_NAME
+        save_pytree(
+            variables,
+            os.path.join(save_directory, name),
+            safe_serialization=safe_serialization,
+            max_shard_size=_parse_size(max_shard_size),
+        )
+    state.wait_for_everyone()
+
+
+def _parse_size(size) -> int:
+    if isinstance(size, int):
+        return size
+    size = str(size).upper().strip()
+    for suffix, mult in (("GB", 1024**3), ("MB", 1024**2), ("KB", 1024)):
+        if size.endswith(suffix):
+            return int(float(size[: -len(suffix)]) * mult)
+    return int(size)
+
+
+def _find(folder: str, stem: str) -> Optional[str]:
+    """Locate `stem`.{safetensors,bin} (or its sharded index) in `folder`."""
+    for ext in (".safetensors.index.json", ".safetensors", ".bin"):
+        p = os.path.join(folder, stem + ext)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _arrays_only(tree):
+    """Flat dict of only the array leaves of an (optax) state pytree."""
+    flat = flatten_pytree(tree)
+    return {k: v for k, v in flat.items() if hasattr(v, "shape")}
+
+
+def _merge_arrays(like_tree, flat):
+    """Rebuild ``like_tree`` replacing array leaves present in ``flat``."""
+    like_flat = flatten_pytree(like_tree)
+    merged = {}
+    for k, v in like_flat.items():
+        merged[k] = flat.get(k, v) if hasattr(v, "shape") else v
+    return unflatten_to_like(merged, like_tree)

@@ -1,0 +1,836 @@
+"""Data layer: sharded samplers + device-feeding dataloaders.
+
+Parity target: /root/reference/src/accelerate/data_loader.py (1,296 LoC):
+``BatchSamplerShard`` (two sharding modes + even_batches wraparound),
+``IterableDatasetShard``, ``SeedableRandomSampler``, ``DataLoaderShard``
+(RNG sync at epoch start, one-batch-ahead prefetch flagging
+``end_of_dataloader``, device placement), ``DataLoaderDispatcher`` (rank0
+fetch + broadcast), ``skip_first_batches``.
+
+TPU-native differences:
+- "process" = host (JAX single-controller-per-host); each host loads its
+  slice of the global batch and the global array is assembled with
+  `jax.make_array_from_process_local_data` — no broadcast in the hot path.
+- Static shapes: the final partial batch is PADDED to full size (repeating
+  head samples, the reference's even_batches wraparound) and ``remainder``
+  records the padding so `gather_for_metrics` can drop it. With
+  ``even_batches=False`` the smaller final batch is yielded as-is (each
+  distinct size triggers one extra XLA compile — documented).
+- Works with torch DataLoaders (re-wrapped), map-style datasets, or any
+  iterable of batches.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .logging import get_logger
+from .state import GradientState, PartialState
+from .utils.dataclasses import DataLoaderConfiguration, RNGType
+from .utils.operations import concatenate, convert_to_jax, find_batch_size, make_global_batch, recursively_apply
+from .utils.random import default_keychain, synchronize_rng_states
+
+logger = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Samplers (pure index math — reference data_loader.py:68-353)
+# ---------------------------------------------------------------------------
+
+class SeedableRandomSampler:
+    """Deterministic shuffling sampler whose permutation depends only on
+    (seed, epoch) (reference data_loader.py:68-100). Counter-based: resuming
+    at epoch N reproduces the exact stream without replaying."""
+
+    def __init__(self, data_source_len: int, seed: int = 0, epoch: int = 0):
+        self.data_source_len = data_source_len
+        self.seed = seed
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.data_source_len
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[int]:
+        key = jax.random.key(self.seed)
+        key = jax.random.fold_in(key, self.epoch)
+        perm = np.asarray(jax.random.permutation(key, self.data_source_len))
+        self.epoch += 1  # auto-advance like the reference (`set_epoch` also works)
+        yield from perm.tolist()
+
+    def state_dict(self) -> dict:
+        return {"seed": self.seed, "epoch": self.epoch}
+
+    def load_state_dict(self, state: dict):
+        self.seed = state["seed"]
+        self.epoch = state["epoch"]
+
+
+class BatchSamplerShard:
+    """Shards an iterable of index-batches across processes
+    (reference data_loader.py:101-253).
+
+    Two modes:
+    - ``split_batches=True``: each global batch is split into
+      ``num_processes`` chunks; batch size must divide evenly.
+    - ``split_batches=False``: whole batches are round-robined — process i
+      gets batches i, i+N, i+2N, ...
+
+    ``even_batches=True`` guarantees all processes get the same number of
+    equal-size batches by wrapping around to the beginning (duplicating head
+    samples), exactly like the reference's :227-253.
+    """
+
+    def __init__(
+        self,
+        batch_sampler: Iterable[Sequence[int]],
+        num_processes: int = 1,
+        process_index: int = 0,
+        split_batches: bool = False,
+        even_batches: bool = True,
+    ):
+        if split_batches and hasattr(batch_sampler, "batch_size") and batch_sampler.batch_size % num_processes != 0:
+            raise ValueError(
+                f"To use `BatchSamplerShard` in `split_batches` mode, the batch size "
+                f"({batch_sampler.batch_size}) needs to be a round multiple of the number "
+                f"of processes ({num_processes})."
+            )
+        self.batch_sampler = batch_sampler
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.split_batches = split_batches
+        self.even_batches = even_batches
+        self.batch_size = getattr(batch_sampler, "batch_size", None)
+        self.drop_last = getattr(batch_sampler, "drop_last", False)
+        if self.batch_size is None and self.even_batches:
+            raise ValueError(
+                "You need to use `even_batches=False` when the batch sampler has no batch size."
+            )
+
+    def __len__(self):
+        if self.split_batches:
+            return len(self.batch_sampler)
+        length = len(self.batch_sampler) // self.num_processes
+        if len(self.batch_sampler) % self.num_processes == 0:
+            return length
+        if self.drop_last:
+            return length
+        if self.even_batches:
+            return length + 1
+        return length + 1 if self.process_index < len(self.batch_sampler) % self.num_processes else length
+
+    @property
+    def total_length(self):
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        return self._iter_with_split() if self.split_batches else self._iter_with_no_split()
+
+    def _iter_with_split(self):
+        # Semantics of reference :187-208: yield this process's slice of each
+        # FULL global batch; on a ragged tail, either yield the partial slice
+        # (even_batches=False) or complete the tail by cycling from the start.
+        initial_data: list = []
+        batch = []
+        chunk = self.batch_size // self.num_processes
+        for idx, batch in enumerate(self.batch_sampler):
+            batch = list(batch)
+            if idx == 0:
+                initial_data = batch
+            if len(batch) == self.batch_size:
+                yield batch[chunk * self.process_index : chunk * (self.process_index + 1)]
+        if not self.drop_last and len(initial_data) > 0 and len(batch) < self.batch_size:
+            if not self.even_batches:
+                if len(batch) > chunk * self.process_index:
+                    yield batch[chunk * self.process_index : chunk * (self.process_index + 1)]
+            else:
+                while len(initial_data) < self.batch_size:
+                    initial_data += initial_data
+                batch = batch + initial_data
+                yield batch[chunk * self.process_index : chunk * (self.process_index + 1)]
+
+    def _iter_with_no_split(self):
+        # Semantics of reference :209-253: round-robin whole batches; a round
+        # only yields once its last batch is full; the tail is completed by
+        # cycling indices from the first `num_processes` batches so every
+        # process ends with the same number of full batches.
+        initial_data: list = []
+        batch_to_yield: list = []
+        idx = -1
+        batch: list = []
+        for idx, batch in enumerate(self.batch_sampler):
+            batch = list(batch)
+            if not self.drop_last and idx < self.num_processes:
+                initial_data += batch
+            if idx % self.num_processes == self.process_index:
+                batch_to_yield = batch
+            if idx % self.num_processes == self.num_processes - 1 and (
+                self.batch_size is None or len(batch) == self.batch_size
+            ):
+                yield batch_to_yield
+                batch_to_yield = []
+        if self.drop_last or len(initial_data) == 0:
+            return
+        if not self.even_batches:
+            if len(batch_to_yield) > 0:
+                yield batch_to_yield
+            return
+        # A full batch saved from an incomplete round is still owed to us.
+        if len(batch_to_yield) == self.batch_size:
+            yield batch_to_yield
+        while len(initial_data) < self.num_processes * self.batch_size:
+            initial_data += initial_data
+        # If the stream's last batch was full, its round position is consumed.
+        if len(batch) == self.batch_size:
+            batch = []
+            idx += 1
+        cycle_index = 0
+        while idx % self.num_processes != 0 or len(batch) > 0:
+            end_index = cycle_index + self.batch_size - len(batch)
+            batch += initial_data[cycle_index:end_index]
+            if idx % self.num_processes == self.process_index:
+                yield batch
+            cycle_index = end_index
+            batch = []
+            idx += 1
+
+
+class SimpleBatchSampler:
+    """Minimal batch sampler over a sampler of indices (torch-free)."""
+
+    def __init__(self, sampler, batch_size: int, drop_last: bool = False):
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(int(idx))
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else math.ceil(n / self.batch_size)
+
+
+class IterableDatasetShard:
+    """Per-process slice of an iterable dataset (reference :257-353): buffer
+    ``batch_size * num_processes`` items, keep this process's slice; final
+    short window wraps around from the buffer head when even_batches."""
+
+    def __init__(
+        self,
+        dataset: Iterable,
+        batch_size: int = 1,
+        drop_last: bool = False,
+        num_processes: int = 1,
+        process_index: int = 0,
+        split_batches: bool = False,
+        even_batches: bool = True,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.split_batches = split_batches
+        self.even_batches = even_batches
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def __iter__(self):
+        real_batch_size = (
+            self.batch_size if self.split_batches else self.batch_size * self.num_processes
+        )
+        process_batch_size = self.batch_size // self.num_processes if self.split_batches else self.batch_size
+        process_slice = range(
+            self.process_index * process_batch_size, (self.process_index + 1) * process_batch_size
+        )
+        first_batch = None
+        current_batch = []
+        for element in self.dataset:
+            current_batch.append(element)
+            if len(current_batch) == real_batch_size:
+                for i in process_slice:
+                    yield current_batch[i]
+                if first_batch is None:
+                    first_batch = current_batch.copy()
+                current_batch = []
+        if not self.drop_last and len(current_batch) > 0:
+            if not self.even_batches:
+                # yield what belongs to this process from the ragged tail
+                for i in process_slice:
+                    if i < len(current_batch):
+                        yield current_batch[i]
+                return
+            if first_batch is None:
+                first_batch = current_batch.copy()
+            while len(current_batch) < real_batch_size:
+                current_batch += first_batch
+            for i in process_slice:
+                yield current_batch[i]
+
+
+# ---------------------------------------------------------------------------
+# Collation
+# ---------------------------------------------------------------------------
+
+def default_collate(samples: list) -> Any:
+    """Stack a list of samples into a batch pytree (numpy)."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples]) for i in range(len(first)))
+    arr = np.asarray(samples)
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# DataLoaders
+# ---------------------------------------------------------------------------
+
+class BaseDataLoader:
+    """Common machinery: GradientState registration + end-of-iteration
+    signaling via one-batch-ahead prefetch (reference DataLoaderAdapter +
+    DataLoaderShard, data_loader.py:399-578)."""
+
+    def __init__(self):
+        self.gradient_state = GradientState()
+        self.end_of_dataloader = False
+        self.remainder = -1
+        self._batches_yielded = 0
+
+    def begin(self):
+        self.end_of_dataloader = False
+        self.gradient_state._add_dataloader(self)
+
+    def end(self):
+        # The singleton may have been reset (tests) before a suspended
+        # generator is finalized; nothing to deregister then.
+        if self.gradient_state.initialized:
+            self.gradient_state._remove_dataloader(self)
+
+    # -- mid-epoch resume support (≙ torchdata StatefulDataLoader contract) --
+    def state_dict(self) -> dict:
+        # After a completed epoch the next iteration starts fresh (epoch
+        # counter already advanced in the generator's finally block).
+        return {
+            "batches_yielded": 0 if self.end_of_dataloader else self._batches_yielded,
+            "iteration": getattr(self, "iteration", 0),
+        }
+
+    def load_state_dict(self, state: dict):
+        self._skip_batches_on_next_iter = state.get("batches_yielded", 0)
+        if "iteration" in state:
+            self.iteration = state["iteration"]
+
+
+class DataLoaderShard(BaseDataLoader):
+    """Iterates a per-host loader and feeds global sharded arrays
+    (reference data_loader.py:491-625).
+
+    Per batch: convert (torch/np → np), pad the final ragged batch when
+    ``even_batches`` (recording ``remainder``), place onto the mesh with
+    batch-dim sharding over the data axes. RNG streams sync at epoch start.
+    """
+
+    def __init__(
+        self,
+        base_loader: Iterable,
+        mesh=None,
+        rng_types: Optional[list] = None,
+        batch_size: Optional[int] = None,
+        even_batches: bool = True,
+        device_put: bool = True,
+        skip_batches: int = 0,
+        _drop_last: bool = False,
+        batch_axes: tuple = ("replica", "data", "fsdp"),
+    ):
+        super().__init__()
+        self.base_loader = base_loader
+        self.mesh = mesh
+        self.rng_types = rng_types or []
+        self.batch_size = batch_size
+        self.even_batches = even_batches
+        self.device_put = device_put
+        self.skip_batches = skip_batches
+        self.batch_axes = batch_axes
+        self._drop_last = _drop_last
+        self._skip_batches_on_next_iter = 0
+        self.iteration = 0
+
+    def set_epoch(self, epoch: int):
+        self.iteration = epoch
+        for obj in (self.base_loader, getattr(self.base_loader, "dataset", None),
+                    getattr(self.base_loader, "sampler", None),
+                    getattr(self.base_loader, "batch_sampler", None)):
+            if obj is not None and hasattr(obj, "set_epoch"):
+                obj.set_epoch(epoch)
+
+    def _global_batch_size(self) -> Optional[int]:
+        if self.batch_size is None:
+            return None
+        return self.batch_size * PartialState().num_processes
+
+    def _finalize_batch(self, batch, pad_to: Optional[int]):
+        batch = convert_to_jax(batch)
+        bs = find_batch_size(batch)
+        if pad_to is not None and bs is not None and bs < pad_to:
+            if self.even_batches:
+                self.remainder = bs
+
+                def _pad(t):
+                    if not hasattr(t, "shape") or t.ndim == 0 or t.shape[0] != bs:
+                        return t
+                    reps = [t]
+                    missing = pad_to - bs
+                    while missing > 0:
+                        take = min(missing, bs)
+                        reps.append(t[:take])
+                        missing -= take
+                    return np.concatenate([np.asarray(r) for r in reps], axis=0)
+
+                batch = recursively_apply(_pad, batch, test_type=lambda x: hasattr(x, "shape"))
+        if self.device_put and self.mesh is not None:
+            batch = make_global_batch(batch, self.mesh, batch_axes=self.batch_axes)
+        return batch
+
+    def __iter__(self):
+        self.begin()
+        self._batches_yielded = 0
+        skip = self.skip_batches + self._skip_batches_on_next_iter
+        self._skip_batches_on_next_iter = 0
+        if self.rng_types:
+            synchronize_rng_states(self.rng_types)
+        self.set_epoch(self.iteration)
+        # remainder = number of REAL samples in the final (padded) global
+        # batch; consumed by gather_for_metrics to drop wraparound duplicates
+        # (reference DataLoaderStateMixin, data_loader.py:356-397).
+        self.remainder = -1
+        tdl = self.total_dataset_length
+        gbs = self._global_batch_size()
+        if self.even_batches and tdl is not None and gbs:
+            rem = tdl % gbs
+            if rem != 0:
+                self.remainder = rem
+        per_proc = self.batch_size
+        try:
+            iterator = iter(self.base_loader)
+            # one-batch-ahead prefetch to flag end_of_dataloader on the LAST
+            # yield (reference :555-578)
+            try:
+                current = next(iterator)
+            except StopIteration:
+                self.end_of_dataloader = True
+                return
+            batch_index = 0
+            while True:
+                try:
+                    upcoming = next(iterator)
+                    at_end = False
+                except StopIteration:
+                    upcoming = None
+                    at_end = True
+                if batch_index >= skip:
+                    if at_end:
+                        self.end_of_dataloader = True
+                        self.gradient_state._set_sync_gradients(
+                            self.gradient_state.sync_gradients
+                            or self.gradient_state.sync_with_dataloader
+                        )
+                    self._batches_yielded += 1
+                    yield self._finalize_batch(current, per_proc)
+                if at_end:
+                    return
+                current = upcoming
+                batch_index += 1
+        finally:
+            self.iteration += 1
+            self.end()
+
+    def __len__(self):
+        return len(self.base_loader)
+
+    @property
+    def total_batch_size(self):
+        return self._global_batch_size()
+
+    @property
+    def total_dataset_length(self):
+        ds = getattr(self.base_loader, "dataset", None)
+        return len(ds) if ds is not None and hasattr(ds, "__len__") else None
+
+
+class DataLoaderDispatcher(BaseDataLoader):
+    """Rank-0 fetches, broadcasts structure + data, every host slices its
+    share (reference data_loader.py:672-852). Only useful for streaming/
+    non-deterministic sources where per-host sharding can't be replicated;
+    the default path (DataLoaderShard) avoids this broadcast entirely.
+    """
+
+    def __init__(
+        self,
+        base_loader: Iterable,
+        mesh=None,
+        batch_size: Optional[int] = None,
+        even_batches: bool = True,
+        skip_batches: int = 0,
+        batch_axes: tuple = ("replica", "data", "fsdp"),
+    ):
+        super().__init__()
+        self.base_loader = base_loader
+        self.mesh = mesh
+        self.batch_size = batch_size
+        self.even_batches = even_batches
+        self.skip_batches = skip_batches
+        self.batch_axes = batch_axes
+        self._skip_batches_on_next_iter = 0
+        self.iteration = 0
+
+    def __iter__(self):
+        from .utils.operations import broadcast_object_list
+
+        state = PartialState()
+        self.begin()
+        self._batches_yielded = 0
+        skip = self.skip_batches + self._skip_batches_on_next_iter
+        self._skip_batches_on_next_iter = 0
+        self.remainder = -1
+        try:
+            iterator = iter(self.base_loader) if state.is_main_process else None
+            batch_index = 0
+            stop = False
+            current = self._fetch_and_share(iterator, state)
+            if current is None:
+                self.end_of_dataloader = True
+                return
+            while True:
+                upcoming = self._fetch_and_share(iterator, state)
+                at_end = upcoming is None
+                if batch_index >= skip:
+                    if at_end:
+                        self.end_of_dataloader = True
+                    self._batches_yielded += 1
+                    yield current
+                if at_end:
+                    return
+                current = upcoming
+                batch_index += 1
+        finally:
+            self.iteration += 1
+            self.end()
+
+    def _fetch_and_share(self, iterator, state):
+        # main process reads the batch; all processes learn the structure,
+        # then the global array is built from main's data only.
+        if state.is_main_process:
+            try:
+                batch = convert_to_jax(next(iterator))
+                info = [_tree_meta(batch)]
+            except StopIteration:
+                info = [None]
+        else:
+            batch, info = None, [None]
+        if state.num_processes > 1:
+            info = broadcast_object_list(info)
+        if info[0] is None:
+            return None
+        if state.num_processes > 1:
+            batch = _scatter_from_main(batch, info[0], self.mesh, state, self.batch_axes)
+        elif self.mesh is not None:
+            batch = make_global_batch(batch, self.mesh, batch_axes=self.batch_axes)
+        return batch
+
+    def __len__(self):
+        return len(self.base_loader)
+
+
+def _tree_meta(batch):
+    return jax.tree_util.tree_map(
+        lambda t: (tuple(t.shape), str(t.dtype)) if hasattr(t, "shape") else t, batch
+    )
+
+
+def _scatter_from_main(batch, meta, mesh, state, batch_axes):
+    """Build a global array where only process 0 contributes data; XLA
+    broadcasts over DCN on first use. Non-main hosts pass zero-filled
+    locals of the right shape."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+
+    def _one(leaf_meta, leaf):
+        if not isinstance(leaf_meta, tuple) or len(leaf_meta) != 2:
+            return leaf_meta if leaf is None else leaf
+        shape, dtype = leaf_meta
+        sharding = NamedSharding(mesh, P(axes))
+        if state.is_main_process:
+            data = np.asarray(leaf)
+        else:
+            data = np.zeros(shape, dtype=np.dtype(dtype))
+        # each host contributes an equal slice; main's slice is authoritative
+        # only for its shard — true dispatch therefore requires
+        # broadcast(batch) first:
+        from .utils.operations import broadcast
+
+        data = broadcast(data)
+        local = np.asarray(data)
+        return jax.make_array_from_process_local_data(sharding, local)
+
+    if state.is_main_process:
+        return jax.tree_util.tree_map(_one, meta, batch)
+    return jax.tree_util.tree_map(lambda m: _one(m, None), meta)
+
+
+# ---------------------------------------------------------------------------
+# factory (reference prepare_data_loader, data_loader.py:913-1157)
+# ---------------------------------------------------------------------------
+
+def prepare_data_loader(
+    dataloader,
+    mesh=None,
+    num_processes: Optional[int] = None,
+    process_index: Optional[int] = None,
+    split_batches: bool = False,
+    put_on_device: bool = True,
+    rng_types: Optional[list] = None,
+    dispatch_batches: Optional[bool] = None,
+    even_batches: bool = True,
+    slice_fn_for_dispatch: Optional[Callable] = None,
+    use_seedable_sampler: bool = True,
+    data_seed: int = 0,
+    config: Optional[DataLoaderConfiguration] = None,
+):
+    """Wrap any of (torch DataLoader | map-style dataset + batch_size |
+    iterable of batches) into a DataLoaderShard/Dispatcher feeding the mesh."""
+    state = PartialState()
+    num_processes = num_processes if num_processes is not None else state.num_processes
+    process_index = process_index if process_index is not None else state.process_index
+    if config is not None:
+        split_batches = config.split_batches
+        dispatch_batches = config.dispatch_batches
+        even_batches = config.even_batches
+        use_seedable_sampler = config.use_seedable_sampler
+
+    if dispatch_batches:
+        return DataLoaderDispatcher(
+            dataloader,
+            mesh=mesh,
+            batch_size=_find_batch_size_attr(dataloader, split_batches, num_processes),
+            even_batches=even_batches,
+        )
+
+    base_loader, per_proc_bs = _shard_loader(
+        dataloader, num_processes, process_index, split_batches, even_batches,
+        use_seedable_sampler, data_seed,
+    )
+    return DataLoaderShard(
+        base_loader,
+        mesh=mesh,
+        rng_types=rng_types,
+        batch_size=per_proc_bs,
+        even_batches=even_batches,
+        device_put=put_on_device,
+    )
+
+
+def _find_batch_size_attr(dataloader, split_batches, num_processes):
+    bs = getattr(dataloader, "batch_size", None)
+    if bs is None:
+        bsampler = getattr(dataloader, "batch_sampler", None)
+        bs = getattr(bsampler, "batch_size", None)
+    if bs is None:
+        return None
+    return bs // num_processes if split_batches else bs
+
+
+def _shard_loader(dataloader, num_processes, process_index, split_batches, even_batches,
+                  use_seedable_sampler, data_seed):
+    """Rebuild the loader so this process only reads its own index shard."""
+    # Case 1: torch DataLoader → re-wrap dataset with sharded batch sampler
+    is_torch_loader = type(dataloader).__module__.startswith("torch.utils.data")
+    if is_torch_loader:
+        dataset = dataloader.dataset
+        batch_sampler = dataloader.batch_sampler
+        collate = dataloader.collate_fn
+        if batch_sampler is None:  # iterable-style torch dataset
+            shard = IterableDatasetShard(
+                dataset,
+                batch_size=dataloader.batch_size,
+                drop_last=dataloader.drop_last,
+                num_processes=num_processes,
+                process_index=process_index,
+                split_batches=split_batches,
+                even_batches=even_batches,
+            )
+            return _SimpleLoader(shard, dataloader.batch_size, collate), dataloader.batch_size
+        sampler = batch_sampler.sampler
+        if use_seedable_sampler and type(sampler).__name__ == "RandomSampler":
+            sampler = SeedableRandomSampler(len(dataset), seed=data_seed)
+        base_bsampler = SimpleBatchSampler(sampler, batch_sampler.batch_size, batch_sampler.drop_last)
+        sharded = BatchSamplerShard(
+            base_bsampler, num_processes, process_index, split_batches, even_batches
+        )
+        per_proc = batch_sampler.batch_size // num_processes if split_batches else batch_sampler.batch_size
+        return _MapLoader(dataset, sharded, collate), per_proc
+
+    # Case 2: our own DataLoader
+    if isinstance(dataloader, DataLoader):
+        sampler = dataloader.sampler
+        if use_seedable_sampler and dataloader.shuffle and not isinstance(sampler, SeedableRandomSampler):
+            sampler = SeedableRandomSampler(len(dataloader.dataset), seed=data_seed)
+        base_bsampler = SimpleBatchSampler(sampler, dataloader.batch_size, dataloader.drop_last)
+        sharded = BatchSamplerShard(
+            base_bsampler, num_processes, process_index, split_batches, even_batches
+        )
+        per_proc = dataloader.batch_size // num_processes if split_batches else dataloader.batch_size
+        return _MapLoader(dataloader.dataset, sharded, dataloader.collate_fn), per_proc
+
+    # Case 3: raw iterable of ready-made batches — shard by round-robin
+    return _RoundRobinLoader(dataloader, num_processes, process_index), None
+
+
+class _MapLoader:
+    """Map-style dataset + batch sampler + collate — the per-host loader."""
+
+    def __init__(self, dataset, batch_sampler, collate_fn=None):
+        self.dataset = dataset
+        self.batch_sampler = batch_sampler
+        self.collate_fn = collate_fn or default_collate
+
+    def __iter__(self):
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __len__(self):
+        return len(self.batch_sampler)
+
+    def set_epoch(self, epoch):
+        for obj in (self.dataset, self.batch_sampler, getattr(self.batch_sampler, "batch_sampler", None)):
+            if obj is not None and hasattr(obj, "set_epoch"):
+                obj.set_epoch(epoch)
+        sampler = getattr(getattr(self.batch_sampler, "batch_sampler", None), "sampler", None)
+        if sampler is not None and hasattr(sampler, "set_epoch"):
+            sampler.set_epoch(epoch)
+
+
+class _SimpleLoader:
+    def __init__(self, iterable_shard, batch_size, collate_fn=None):
+        self.dataset = iterable_shard
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+
+    def __iter__(self):
+        buf = []
+        for item in self.dataset:
+            buf.append(item)
+            if len(buf) == self.batch_size:
+                yield self.collate_fn(buf)
+                buf = []
+        if buf:
+            yield self.collate_fn(buf)
+
+    def set_epoch(self, epoch):
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+
+class _RoundRobinLoader:
+    def __init__(self, iterable, num_processes, process_index):
+        self.iterable = iterable
+        self.num_processes = num_processes
+        self.process_index = process_index
+
+    def __iter__(self):
+        for i, batch in enumerate(self.iterable):
+            if i % self.num_processes == self.process_index:
+                yield batch
+
+    def __len__(self):
+        n = len(self.iterable)
+        extra = 1 if n % self.num_processes > self.process_index else 0
+        return n // self.num_processes + extra
+
+    def set_epoch(self, epoch):
+        if hasattr(self.iterable, "set_epoch"):
+            self.iterable.set_epoch(epoch)
+
+
+class DataLoader:
+    """Torch-free map-style dataloader (construct, then `accelerator.prepare`).
+
+    Datasets are anything with ``__getitem__``/``__len__`` yielding pytrees.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate
+        self.seed = seed
+        if shuffle:
+            self.sampler = SeedableRandomSampler(len(dataset), seed=seed)
+        else:
+            self.sampler = range(len(dataset))
+
+    def __iter__(self):
+        bsampler = SimpleBatchSampler(self.sampler, self.batch_size, self.drop_last)
+        for indices in bsampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __len__(self):
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else math.ceil(n / self.batch_size)
+
+
+# ---------------------------------------------------------------------------
+# skip_first_batches (reference data_loader.py:1160-1253)
+# ---------------------------------------------------------------------------
+
+def skip_first_batches(dataloader, num_batches: int = 0):
+    """Resume mid-epoch: a loader that skips the first ``num_batches``."""
+    if isinstance(dataloader, (DataLoaderShard, DataLoaderDispatcher)):
+        import copy
+
+        new = copy.copy(dataloader)
+        new.skip_batches = dataloader.skip_batches + num_batches
+        return new
+
+    class _Skipper:
+        def __init__(self, inner, n):
+            self.inner = inner
+            self.n = n
+            self.dataset = getattr(inner, "dataset", None)
+
+        def __iter__(self):
+            for i, batch in enumerate(self.inner):
+                if i >= self.n:
+                    yield batch
+
+        def __len__(self):
+            return max(0, len(self.inner) - self.n)
+
+    return _Skipper(dataloader, num_batches)

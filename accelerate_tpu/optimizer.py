@@ -1,0 +1,93 @@
+"""Optimizer wrapper (parity: /root/reference/src/accelerate/optimizer.py,
+214 LoC: AcceleratedOptimizer).
+
+The reference wraps a torch optimizer: device-places its state, skips
+``step()`` during accumulation, runs the GradScaler dance, detects skipped
+steps. Here the optimizer is an optax ``GradientTransformation`` and the
+actual update is one fused jit (owned by the TrainEngine in accelerator.py);
+this wrapper keeps the *call-site contract*: ``optimizer.step()``,
+``optimizer.zero_grad()``, ``optimizer.state_dict()``,
+``optimizer_step_was_skipped`` all behave like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedOptimizer:
+    def __init__(self, optimizer, engine=None):
+        # ``optimizer`` is an optax GradientTransformation (pair of pure fns);
+        # ``engine`` is wired in by Accelerator.prepare.
+        self.optimizer = optimizer
+        self.engine = engine
+        self.accelerator_state = AcceleratorState()
+        self.gradient_state = GradientState()
+
+    @property
+    def state(self):
+        """Current optax state (a pytree of global jax.Arrays)."""
+        if self.engine is None:
+            return None
+        return self.engine.opt_state
+
+    @property
+    def param_groups(self):
+        """Torch-parity shim: one group exposing the current lr."""
+        lr = None
+        if self.engine is not None:
+            lr = self.engine.current_learning_rate()
+        return [{"lr": lr, "params": []}]
+
+    def state_dict(self):
+        if self.engine is None:
+            return {}
+        return {"opt_state": self.engine.opt_state, "step_count": self.engine.step_count}
+
+    def load_state_dict(self, state_dict):
+        if self.engine is not None:
+            self.engine.load_optimizer_state(state_dict)
+
+    def zero_grad(self, set_to_none: bool = True):
+        """Reset the gradient-accumulation buffer. Gated on sync_gradients
+        exactly like the reference (optimizer.py:112-122): during
+        accumulation this is a no-op so grads keep accumulating."""
+        if self.gradient_state.sync_gradients and self.engine is not None:
+            self.engine.zero_grad()
+
+    def step(self, closure=None):
+        """Apply the fused update. Skips silently while accumulating
+        (reference optimizer.py:153); with fp16 the update is conditionally
+        skipped on non-finite grads inside the jit (GradScaler analog)."""
+        if closure is not None:
+            closure()
+        if not self.gradient_state.sync_gradients:
+            return
+        if self.engine is None:
+            raise RuntimeError(
+                "This AcceleratedOptimizer is not attached to a model; pass the "
+                "model and optimizer to `accelerator.prepare` together."
+            )
+        self.engine.optimizer_step()
+
+    def train(self):  # torch-parity no-op
+        return self
+
+    def eval(self):  # torch-parity no-op
+        return self
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """True when the last ``step`` was skipped because of non-finite
+        fp16 gradients (reference accelerator.optimizer_step_was_skipped)."""
+        if self.engine is None:
+            return False
+        return self.engine.last_step_skipped()
+
+    def __getstate__(self):
+        return self.__dict__.copy()
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)

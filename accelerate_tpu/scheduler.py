@@ -1,0 +1,71 @@
+"""Scheduler wrapper (parity: /root/reference/src/accelerate/scheduler.py,
+98 LoC: AcceleratedScheduler).
+
+In optax the learning-rate schedule is a pure function of the update count
+and is evaluated *inside* the fused jit update — there is no stateful
+`.step()` to call. This wrapper keeps the reference call-site contract
+(``scheduler.step()`` after ``optimizer.step()``, ``get_last_lr``,
+``state_dict``) and preserves the semantics that the schedule only advances
+when the optimizer really stepped (reference scheduler.py:54-82): the
+authoritative counter is the engine's ``step_count``, which accumulation or
+fp16-skip never bumps.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .state import GradientState
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        schedule: Callable[[int], float],
+        optimizers=None,
+        split_batches: bool = False,
+        step_with_optimizer: bool = True,
+    ):
+        # ``schedule`` is an optax schedule fn: step -> lr. It must be the
+        # SAME schedule baked into the optax optimizer passed to prepare()
+        # (optax evaluates it in the update); this wrapper only reports it.
+        self.schedule = schedule
+        self.optimizers = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        self.split_batches = split_batches
+        self.step_with_optimizer = step_with_optimizer
+        self.gradient_state = GradientState()
+        self._manual_steps = 0
+
+    @property
+    def _engine(self):
+        for opt in self.optimizers:
+            if opt is not None and getattr(opt, "engine", None) is not None:
+                return opt.engine
+        return None
+
+    @property
+    def last_step(self) -> int:
+        engine = self._engine
+        if engine is not None:
+            return int(engine.step_count)
+        return self._manual_steps
+
+    def step(self, *args, **kwargs):
+        """Parity no-op-with-bookkeeping: optax advanced the schedule inside
+        the fused update; we only track manual counts for the detached case."""
+        if not self.step_with_optimizer:
+            self._manual_steps += 1
+        # when attached, nothing to do: engine.step_count is authoritative
+        # and already excludes accumulation/skipped steps.
+
+    def get_last_lr(self):
+        return [float(self.schedule(self.last_step))]
+
+    def get_lr(self):
+        return self.get_last_lr()
+
+    def state_dict(self):
+        return {"manual_steps": self._manual_steps}
+
+    def load_state_dict(self, state_dict):
+        self._manual_steps = state_dict.get("manual_steps", 0)

@@ -1,0 +1,55 @@
+"""Test fixtures (parity: reference test_utils/training.py, 101 LoC:
+RegressionModel y=a*x+b + RegressionDataset used across the suite)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RegressionDataset:
+    def __init__(self, a=2, b=3, length=64, seed=42):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + 0.05 * rng.normal(size=(length,))).astype(np.float32)
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+class RegressionModel:
+    """flax module computing loss = mean((a*x + b - y)^2)."""
+
+    def __new__(cls, a=0.0, b=0.0):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        a0, b0 = float(a), float(b)
+
+        class _Regression(nn.Module):
+            @nn.compact
+            def __call__(self, x, y=None):
+                a = self.param("a", lambda k: jnp.asarray(a0))
+                b = self.param("b", lambda k: jnp.asarray(b0))
+                pred = a * x + b
+                out = {"logits": pred}
+                if y is not None:
+                    out["loss"] = jnp.mean((pred - y) ** 2)
+                return out
+
+        return _Regression()
+
+
+def make_regression_model(a=0.0, b=0.0):
+    """Returns accelerate_tpu.Model wrapping the regression module."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..accelerator import Model
+
+    module = RegressionModel(a, b)
+    variables = module.init(jax.random.key(0), jnp.zeros((2,)), jnp.zeros((2,)))
+    return Model(module, variables)

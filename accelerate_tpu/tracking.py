@@ -1,0 +1,484 @@
+"""Experiment trackers (parity: /root/reference/src/accelerate/tracking.py,
+1,023 LoC: GeneralTracker ABC + 7 built-ins + filter_trackers).
+
+Same plugin design: a `GeneralTracker` ABC whose methods are gated to the
+main process, concrete trackers for tensorboard/wandb/mlflow/comet/aim/
+clearml/dvclive when their packages are importable, plus a dependency-free
+`JSONLTracker` (always available — useful on TPU pods where only the main
+host has egress).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from functools import wraps
+from typing import Any, Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.dataclasses import LoggerType
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_dvclive_available,
+    is_mlflow_available,
+    is_tensorboard_available,
+    is_wandb_available,
+)
+
+logger = get_logger(__name__)
+
+
+def on_main_process(function):
+    """Run tracker methods on the main process only (reference tracking.py:67)."""
+
+    @wraps(function)
+    def execute_on_main_process(self, *args, **kwargs):
+        if getattr(self, "main_process_only", True):
+            state = PartialState()
+            if state.is_main_process:
+                return function(self, *args, **kwargs)
+        else:
+            return function(self, *args, **kwargs)
+
+    return execute_on_main_process
+
+
+def get_available_trackers() -> list:
+    out = [LoggerType.JSONL]
+    if is_tensorboard_available():
+        out.append(LoggerType.TENSORBOARD)
+    if is_wandb_available():
+        out.append(LoggerType.WANDB)
+    if is_mlflow_available():
+        out.append(LoggerType.MLFLOW)
+    if is_comet_ml_available():
+        out.append(LoggerType.COMETML)
+    if is_aim_available():
+        out.append(LoggerType.AIM)
+    if is_clearml_available():
+        out.append(LoggerType.CLEARML)
+    if is_dvclive_available():
+        out.append(LoggerType.DVCLIVE)
+    return out
+
+
+class GeneralTracker:
+    """Tracker ABC (reference tracking.py:91)."""
+
+    main_process_only = True
+    name = "blank"
+    requires_logging_directory = False
+
+    def __init__(self, _blank: bool = False):
+        self._blank = _blank
+
+    @property
+    def tracker(self):
+        return None
+
+    def store_init_configuration(self, values: dict):
+        pass
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        pass
+
+    def finish(self):
+        pass
+
+
+class JSONLTracker(GeneralTracker):
+    """Append-only metrics file, one JSON object per log call."""
+
+    name = "jsonl"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Union[str, os.PathLike]):
+        super().__init__()
+        self.run_name = run_name
+        os.makedirs(os.path.join(logging_dir, run_name), exist_ok=True)
+        self.path = os.path.join(logging_dir, run_name, "metrics.jsonl")
+        self._fh = open(self.path, "a")
+
+    @property
+    def tracker(self):
+        return self._fh
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self._write({"event": "config", "values": _jsonable(values)})
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self._write({"event": "log", "step": step, "time": time.time(), "values": _jsonable(values)})
+
+    def _write(self, obj):
+        self._fh.write(json.dumps(obj) + "\n")
+        self._fh.flush()
+
+    @on_main_process
+    def finish(self):
+        self._fh.close()
+
+
+class TensorBoardTracker(GeneralTracker):
+    """reference tracking.py:165 — via torch.utils.tensorboard or tensorboardX."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Union[str, os.PathLike], **kwargs):
+        super().__init__()
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+        logger.debug(f"Initialized TensorBoard project {self.run_name} logging to {self.logging_dir}")
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.add_hparams(_flatten_scalars(values), metric_dict={})
+        self.writer.flush()
+        try:
+            import yaml
+
+            with open(os.path.join(self.logging_dir, "hparams.yml"), "w") as outfile:
+                yaml.dump(_jsonable(values), outfile)
+        except Exception:
+            with open(os.path.join(self.logging_dir, "hparams.json"), "w") as outfile:
+                json.dump(_jsonable(values), outfile)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        values = _jsonable(values)
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.add_scalar(k, v, global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """reference tracking.py:276."""
+
+    name = "wandb"
+    requires_logging_directory = False
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import wandb
+
+        self.run_name = run_name
+        self.run = wandb.init(project=self.run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    """reference tracking.py:579."""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, experiment_name: Optional[str] = None, logging_dir=None, **kwargs):
+        super().__init__()
+        import mlflow
+
+        experiment_name = os.environ.get("MLFLOW_EXPERIMENT_NAME", experiment_name)
+        mlflow.set_experiment(experiment_name)
+        self.active_run = mlflow.start_run(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.active_run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import mlflow
+
+        for name, value in list(values.items()):
+            if len(str(value)) > mlflow.utils.validation.MAX_PARAM_VAL_LENGTH:
+                del values[name]
+        mlflow.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        import mlflow
+
+        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self):
+        import mlflow
+
+        mlflow.end_run()
+
+
+class CometMLTracker(GeneralTracker):
+    """reference tracking.py:399."""
+
+    name = "comet_ml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        from comet_ml import Experiment
+
+        self.run_name = run_name
+        self.writer = Experiment(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.writer.set_step(step)
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.log_metric(k, v, step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.log_other(k, v, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.log_metrics(v, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.end()
+
+
+class AimTracker(GeneralTracker):
+    """reference tracking.py:480."""
+
+    name = "aim"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir=".", **kwargs):
+        super().__init__()
+        from aim import Run
+
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for key, value in values.items():
+            self.writer.track(value, name=key, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class ClearMLTracker(GeneralTracker):
+    """reference tracking.py:724."""
+
+    name = "clearml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: Optional[str] = None, **kwargs):
+        super().__init__()
+        from clearml import Task
+
+        current = Task.current_task()
+        self._initialized_externally = current is not None
+        self.task = current or Task.init(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            if isinstance(v, (int, float)) and step is not None:
+                clearml_logger.report_scalar(title=k, series=k, value=v, iteration=step, **kwargs)
+            else:
+                clearml_logger.report_single_value(name=k, value=v, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        if self.task and not self._initialized_externally:
+            self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    """reference tracking.py:876."""
+
+    name = "dvclive"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: Optional[str] = None, live=None, **kwargs):
+        super().__init__()
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.live.log_params(_flatten_scalars(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            self.live.log_metric(k, v, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.live.end()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "jsonl": JSONLTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+    "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+}
+
+
+def filter_trackers(log_with, logging_dir=None):
+    """Resolve "all"/names/instances to available tracker types
+    (reference tracking.py:971)."""
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    loggers = []
+    available = get_available_trackers()
+    if "all" in log_with or LoggerType.ALL in log_with:
+        loggers = [t for t in available]
+    else:
+        for item in log_with:
+            if isinstance(item, GeneralTracker):
+                loggers.append(item)
+                continue
+            try:
+                item = LoggerType(str(item))
+            except ValueError:
+                raise ValueError(
+                    f"Unknown tracker {item!r}; choose from {[str(t) for t in available]}"
+                )
+            if item not in available:
+                logger.warning(f"Tried adding logger {item} but package is not installed; skipping.")
+            else:
+                loggers.append(item)
+    for t in loggers:
+        if not isinstance(t, GeneralTracker) and LOGGER_TYPE_TO_CLASS[t.value].requires_logging_directory and logging_dir is None:
+            raise ValueError(f"Logging with `{t}` requires a `logging_dir` (set project_dir)")
+    return loggers
+
+
+def resolve_trackers(log_with, project_name: str, logging_dir=None, init_kwargs: dict = {}) -> list:
+    trackers = []
+    for t in log_with:
+        if isinstance(t, GeneralTracker):
+            trackers.append(t)
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[t.value]
+        kw = init_kwargs.get(t.value, {})
+        if cls.requires_logging_directory:
+            trackers.append(cls(project_name, logging_dir, **kw))
+        else:
+            trackers.append(cls(project_name, **kw))
+    return trackers
+
+
+def _jsonable(values):
+    import numpy as np
+
+    out = {}
+    for k, v in values.items():
+        if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+            out[k] = v.item()
+        elif isinstance(v, (np.ndarray,)):
+            out[k] = v.tolist()
+        elif isinstance(v, dict):
+            out[k] = _jsonable(v)
+        else:
+            out[k] = v
+    return out
+
+
+def _flatten_scalars(values, prefix=""):
+    flat = {}
+    for k, v in values.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten_scalars(v, prefix=key + "/"))
+        elif isinstance(v, (int, float, str, bool)):
+            flat[key] = v
+        else:
+            flat[key] = str(v)
+    return flat

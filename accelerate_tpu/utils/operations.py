@@ -157,13 +157,24 @@ def make_global_batch(data, mesh: Mesh, batch_axes=("replica", "data", "fsdp")):
     """
     batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
     sharding = NamedSharding(mesh, P(batch_axes))
+    shard_degree = int(np.prod([mesh.shape[a] for a in batch_axes])) if batch_axes else 1
     data = convert_to_jax(data)
 
     def _place(x):
         if not is_array_like(x):
             return x
         x = np.asarray(x)
-        if jax.process_count() == 1:
+        nproc = jax.process_count()
+        global_rows = x.shape[0] * nproc if x.ndim >= 1 else None
+        if global_rows is not None and global_rows % shard_degree != 0:
+            raise ValueError(
+                f"global batch dimension {global_rows} (= per-process "
+                f"{x.shape[0]} x {nproc} processes) is not divisible by the "
+                f"data-sharding degree {shard_degree} (mesh axes {batch_axes}). "
+                "Pick a per-process batch size so that batch_size * num_processes "
+                "is a multiple of the data/fsdp mesh axes product."
+            )
+        if nproc == 1:
             return jax.device_put(x, sharding)
         return jax.make_array_from_process_local_data(sharding, x)
 

@@ -1,0 +1,320 @@
+"""End-to-end Accelerator tests (parity: reference tests/test_accelerator.py
+755 LoC + test_utils/scripts/test_script.py training_check)."""
+
+import numpy as np
+import optax
+import pytest
+
+import accelerate_tpu
+from accelerate_tpu import GradientAccumulationPlugin, ShardingConfig
+from accelerate_tpu.data import DataLoader
+from accelerate_tpu.test_utils import RegressionDataset, make_regression_model
+
+
+def make_accelerator(**kwargs):
+    from accelerate_tpu.accelerator import Accelerator
+
+    return Accelerator(**kwargs)
+
+
+def run_training(accelerator, epochs=3, lr=0.1, grad_accum_ctx=True, clip=None):
+    model = make_regression_model()
+    optimizer = optax.sgd(lr)
+    dl = DataLoader(RegressionDataset(length=64), batch_size=16, shuffle=True)
+    model, optimizer, dl = accelerator.prepare(model, optimizer, dl)
+    first_loss = None
+    last_loss = None
+    for _ in range(epochs):
+        for batch in dl:
+            with accelerator.accumulate(model):
+                out = model(batch["x"], batch["y"])
+                loss = out["loss"]
+                accelerator.backward(loss)
+                if clip is not None:
+                    accelerator.clip_grad_norm_(max_norm=clip)
+                optimizer.step()
+                optimizer.zero_grad()
+            if first_loss is None:
+                first_loss = float(loss)
+            last_loss = float(loss)
+    return model, first_loss, last_loss
+
+
+class TestTrainingLoop:
+    def test_loss_decreases(self):
+        accelerator = make_accelerator()
+        model, first, last = run_training(accelerator)
+        assert last < first * 0.5, (first, last)
+        params = model.params
+        assert abs(float(np.asarray(params["a"])) - 2.0) < 0.5
+        assert abs(float(np.asarray(params["b"])) - 3.0) < 0.5
+
+    def test_bf16(self):
+        accelerator = make_accelerator(mixed_precision="bf16")
+        _, first, last = run_training(accelerator)
+        assert last < first * 0.5
+
+    def test_fp16_loss_scaling(self):
+        accelerator = make_accelerator(mixed_precision="fp16")
+        model, first, last = run_training(accelerator)
+        assert last < first * 0.5
+        assert not accelerator.optimizer_step_was_skipped
+
+    def test_clip_grad_norm(self):
+        accelerator = make_accelerator()
+        model, first, last = run_training(accelerator, clip=1.0)
+        assert last < first
+
+    def test_fsdp_strategy(self):
+        accelerator = make_accelerator(
+            sharding_config=ShardingConfig(strategy="FSDP", min_weight_size_to_shard=1)
+        )
+        _, first, last = run_training(accelerator)
+        assert last < first * 0.5
+
+    def test_gradient_accumulation(self):
+        plugin = GradientAccumulationPlugin(num_steps=2)
+        accelerator = make_accelerator(gradient_accumulation_plugin=plugin)
+        model = make_regression_model()
+        optimizer = optax.sgd(0.1)
+        dl = DataLoader(RegressionDataset(length=64), batch_size=16)
+        model, optimizer, dl = accelerator.prepare(model, optimizer, dl)
+        steps_before = model._engine.step_count
+        sync_flags = []
+        for batch in dl:
+            with accelerator.accumulate(model):
+                out = model(batch["x"], batch["y"])
+                accelerator.backward(out["loss"])
+                sync_flags.append(accelerator.sync_gradients)
+                optimizer.step()
+                optimizer.zero_grad()
+        # 4 batches, accum 2 -> optimizer stepped twice
+        assert model._engine.step_count - steps_before == 2
+        assert sync_flags == [False, True, False, True]
+
+    def test_accumulation_matches_big_batch(self):
+        # grads from 2 micro-batches of 8 must equal one batch of 16 (SGD)
+        def train(accum, batch_size, n):
+            from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+            AcceleratorState._reset_state(reset_partial_state=True)
+            accelerator = make_accelerator(
+                gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=accum)
+            )
+            model = make_regression_model()
+            optimizer = optax.sgd(0.1)
+            dl = DataLoader(RegressionDataset(length=n), batch_size=batch_size)
+            model, optimizer, dl = accelerator.prepare(model, optimizer, dl)
+            for batch in dl:
+                with accelerator.accumulate(model):
+                    out = model(batch["x"], batch["y"])
+                    accelerator.backward(out["loss"])
+                    optimizer.step()
+                    optimizer.zero_grad()
+            return {k: np.asarray(v) for k, v in model.params.items()}
+
+        p_small = train(accum=2, batch_size=16, n=32)
+        p_big = train(accum=1, batch_size=32, n=32)
+        for k in p_small:
+            np.testing.assert_allclose(p_small[k], p_big[k], rtol=2e-4)
+
+    def test_scheduler_steps_with_optimizer(self):
+        accelerator = make_accelerator(
+            gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=2)
+        )
+        model = make_regression_model()
+        schedule = optax.linear_schedule(0.1, 0.0, 10)
+        optimizer = optax.sgd(schedule)
+        dl = DataLoader(RegressionDataset(length=64), batch_size=16)
+        model, optimizer, dl, scheduler = accelerator.prepare(model, optimizer, dl, schedule)
+        lrs = []
+        for batch in dl:
+            with accelerator.accumulate(model):
+                out = model(batch["x"], batch["y"])
+                accelerator.backward(out["loss"])
+                optimizer.step()
+                scheduler.step()
+                optimizer.zero_grad()
+            lrs.append(scheduler.get_last_lr()[0])
+        # 4 batches, accum 2 -> schedule advanced twice
+        assert lrs == pytest.approx([0.1, 0.09, 0.09, 0.08])
+
+    def test_eval_mode_no_grads(self):
+        accelerator = make_accelerator()
+        model = make_regression_model()
+        optimizer = optax.sgd(0.1)
+        model, optimizer = accelerator.prepare(model, optimizer)
+        model.eval()
+        ds = RegressionDataset(length=8)
+        out = model(np.asarray(ds.x[:8]), np.asarray(ds.y[:8]))
+        assert "loss" in out
+        with pytest.raises(RuntimeError):
+            accelerator._engines[0].backward()
+
+    def test_unwrap_model(self):
+        accelerator = make_accelerator()
+        model = make_regression_model()
+        prepared = accelerator.prepare(model)
+        unwrapped = accelerator.unwrap_model(prepared)
+        assert unwrapped.definition is model.definition
+        assert "a" in unwrapped.params
+
+
+class TestFusedStep:
+    def test_build_train_step(self):
+        accelerator = make_accelerator()
+        model = make_regression_model()
+        optimizer = optax.sgd(0.1)
+        dl = DataLoader(RegressionDataset(length=64), batch_size=16)
+        model, optimizer, dl = accelerator.prepare(model, optimizer, dl)
+        step = accelerator.build_train_step()
+        losses = []
+        for _ in range(3):
+            for batch in dl:
+                metrics = step(batch)
+                losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_fused_matches_eager(self):
+        def run(fused):
+            from accelerate_tpu.state import AcceleratorState
+
+            AcceleratorState._reset_state(reset_partial_state=True)
+            accelerator = make_accelerator()
+            model = make_regression_model()
+            optimizer = optax.sgd(0.05)
+            dl = DataLoader(RegressionDataset(length=32), batch_size=16)
+            model, optimizer, dl = accelerator.prepare(model, optimizer, dl)
+            if fused:
+                step = accelerator.build_train_step()
+                for batch in dl:
+                    step(batch)
+            else:
+                for batch in dl:
+                    out = model(batch["x"], batch["y"])
+                    accelerator.backward(out["loss"])
+                    optimizer.step()
+                    optimizer.zero_grad()
+            return {k: np.asarray(v) for k, v in model.params.items()}
+
+        p_eager = run(False)
+        p_fused = run(True)
+        for k in p_eager:
+            np.testing.assert_allclose(p_eager[k], p_fused[k], rtol=1e-5)
+
+
+class TestCheckpointing:
+    def test_save_load_roundtrip(self, tmp_path):
+        accelerator = make_accelerator()
+        model = make_regression_model()
+        optimizer = optax.adam(0.05)
+        dl = DataLoader(RegressionDataset(length=32), batch_size=16)
+        model, optimizer, dl = accelerator.prepare(model, optimizer, dl)
+        for batch in dl:
+            out = model(batch["x"], batch["y"])
+            accelerator.backward(out["loss"])
+            optimizer.step()
+            optimizer.zero_grad()
+        params_before = {k: np.asarray(v) for k, v in model.params.items()}
+        step_before = model._engine.step_count
+        accelerator.save_state(str(tmp_path / "ckpt"))
+
+        # corrupt state, then restore
+        import jax.numpy as jnp
+
+        model._engine.params = {k: jnp.zeros_like(v) for k, v in model._engine.params.items()}
+        accelerator.load_state(str(tmp_path / "ckpt"))
+        params_after = {k: np.asarray(v) for k, v in model.params.items()}
+        for k in params_before:
+            np.testing.assert_allclose(params_before[k], params_after[k])
+        assert model._engine.step_count == step_before
+
+    def test_training_continues_identically(self, tmp_path):
+        """save -> train 2 more -> reload -> retrain 2 -> identical params
+        (reference tests/test_state_checkpointing.py)."""
+
+        def setup():
+            from accelerate_tpu.state import AcceleratorState
+
+            AcceleratorState._reset_state(reset_partial_state=True)
+            accelerator = make_accelerator()
+            model = make_regression_model()
+            optimizer = optax.adam(0.05)
+            dl = DataLoader(RegressionDataset(length=32), batch_size=16, shuffle=True, seed=7)
+            model, optimizer, dl = accelerator.prepare(model, optimizer, dl)
+            return accelerator, model, optimizer, dl
+
+        accelerator, model, optimizer, dl = setup()
+
+        def train_epoch():
+            for batch in dl:
+                out = model(batch["x"], batch["y"])
+                accelerator.backward(out["loss"])
+                optimizer.step()
+                optimizer.zero_grad()
+
+        train_epoch()
+        accelerator.save_state(str(tmp_path / "ck"))
+        train_epoch()
+        params_run1 = {k: np.asarray(v) for k, v in model.params.items()}
+
+        accelerator, model, optimizer, dl = setup()
+        accelerator.load_state(str(tmp_path / "ck"))
+        train_epoch()
+        params_run2 = {k: np.asarray(v) for k, v in model.params.items()}
+        for k in params_run1:
+            np.testing.assert_allclose(params_run1[k], params_run2[k], rtol=1e-6)
+
+    def test_register_for_checkpointing(self, tmp_path):
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def state_dict(self):
+                return {"n": self.n}
+
+            def load_state_dict(self, sd):
+                self.n = sd["n"]
+
+        accelerator = make_accelerator()
+        model = accelerator.prepare(make_regression_model())
+        c = Counter()
+        c.n = 5
+        accelerator.register_for_checkpointing(c)
+        accelerator.save_state(str(tmp_path / "ck"))
+        c.n = 0
+        accelerator.load_state(str(tmp_path / "ck"))
+        assert c.n == 5
+
+    def test_save_model_weights(self, tmp_path):
+        accelerator = make_accelerator()
+        model = accelerator.prepare(make_regression_model())
+        accelerator.save_model(model, str(tmp_path / "weights"))
+        assert (tmp_path / "weights" / "model.safetensors").exists()
+
+
+class TestMetricsGather:
+    def test_gather_for_metrics_dedups_padding(self):
+        accelerator = make_accelerator()
+        dl = DataLoader(RegressionDataset(length=20), batch_size=16)
+        dl = accelerator.prepare(dl)
+        seen = 0
+        for batch in dl:
+            gathered = accelerator.gather_for_metrics(batch["x"])
+            seen += gathered.shape[0]
+        assert seen == 20  # 16 + 4 (padding dropped)
+
+
+class TestTrackers:
+    def test_jsonl_tracker(self, tmp_path):
+        accelerator = make_accelerator(log_with="jsonl", project_dir=str(tmp_path))
+        accelerator.init_trackers("run1", config={"lr": 0.1})
+        accelerator.log({"loss": 1.5}, step=0)
+        accelerator.log({"loss": 0.5}, step=1)
+        accelerator.end_training()
+        import json
+
+        lines = [json.loads(l) for l in open(tmp_path / "run1" / "metrics.jsonl")]
+        assert lines[0]["event"] == "config"
+        assert lines[1]["values"]["loss"] == 1.5
+        assert lines[2]["step"] == 1
