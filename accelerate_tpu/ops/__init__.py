@@ -1,0 +1,24 @@
+"""TPU compute ops.
+
+Design stance: pallas kernels ONLY where they beat XLA fusion (attention —
+the O(S^2) memory-bound hot spot); everything elementwise-adjacent
+(rmsnorm, rope, swiglu, losses) is written as plain jnp so XLA fuses it
+into neighboring matmuls (SURVEY §"Design for tpu hardware": "Let XLA
+fuse — don't hand-schedule what the compiler already does").
+"""
+
+from .attention import dot_product_attention, flash_attention, mha_reference
+from .layers import apply_rotary_embedding, rms_norm, rotary_embedding_tables, swiglu
+from .losses import fused_linear_cross_entropy, softmax_cross_entropy
+
+__all__ = [
+    "dot_product_attention",
+    "flash_attention",
+    "mha_reference",
+    "apply_rotary_embedding",
+    "rms_norm",
+    "rotary_embedding_tables",
+    "swiglu",
+    "fused_linear_cross_entropy",
+    "softmax_cross_entropy",
+]

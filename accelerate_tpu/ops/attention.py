@@ -1,0 +1,389 @@
+"""Flash attention for TPU in pallas, with an XLA reference fallback.
+
+This is the one op where a hand kernel beats XLA fusion: materializing the
+[S, S] score matrix in HBM is the memory wall, and the online-softmax
+streaming formulation keeps everything in VMEM. Layout is [batch, heads,
+seq, head_dim] (MXU-friendly: the last two dims tile onto the 128x128
+systolic array).
+
+The reference framework has no attention kernels at all (it delegates
+compute to the wrapped torch model); this op exists because our framework
+ships model implementations (models/) whose hot path must be TPU-native.
+Long-context ring attention (parallel/context.py) composes with this
+kernel as its per-shard inner step.
+
+Capabilities:
+- causal or full attention, fp32 accumulation, bf16 in/out
+- GQA/MQA (kv heads broadcast over query-head groups)
+- custom VJP: pallas forward AND backward (dq and dk/dv kernels)
+- `interpret=True` runs the same kernels on CPU for tests
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() semantics with no NaN risk
+
+
+# ---------------------------------------------------------------------------
+# XLA reference (CPU fallback + ground truth for kernel tests)
+# ---------------------------------------------------------------------------
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain-XLA attention. q: [B, H, Sq, D]; k/v: [B, KVH, Skv, D]."""
+    orig_dtype = q.dtype
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    b, h, sq, d = q.shape
+    kvh = k.shape[1]
+    if kvh != h:
+        group = h // kvh
+        q = q.reshape(b, kvh, group, sq, d)
+        s = jnp.einsum("bkgqd,bkcd->bkgqc", q, k, preferred_element_type=jnp.float32)
+    else:
+        s = jnp.einsum("bhqd,bhcd->bhqc", q, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if causal:
+        skv = k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, skv), dtype=bool), k=skv - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if kvh != h:
+        out = jnp.einsum("bkgqc,bkcd->bkgqd", p.astype(v.dtype), v)
+        out = out.reshape(b, h, sq, d)
+    else:
+        out = jnp.einsum("bhqc,bhcd->bhqd", p.astype(v.dtype), v)
+    return out.astype(orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas kernels (MHA core; GQA handled by the public wrapper)
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, sm_scale, causal, bq, bk, nk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    # causal: skip kv blocks entirely above the diagonal
+    run = (iq + 1) * bq > ik * bk if causal else ik >= 0
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * sm_scale
+        if causal:
+            rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        m_prev = m_scr[...][:, :1]
+        l_prev = l_scr[...][:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)
+        l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc[...] = acc[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _out():
+        l = l_scr[...][:, :1]
+        m = m_scr[...][:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m + jnp.log(safe_l))[:, 0]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, sm_scale, causal, bq, bk, nk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    run = (iq + 1) * bq > ik * bk if causal else ik >= 0
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ik == nk - 1)
+    def _out():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal, bq, bk, nq):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = (iq + 1) * bq > ik * bk if causal else iq >= 0
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale  # [bq, bk]
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(iq == nq - 1)
+    def _out():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+
+
+def _pick_block(s: int, preferred: int) -> int:
+    for cand in (preferred, 512, 256, 128):
+        if cand <= s and s % cand == 0:
+            return cand
+    return 0  # no valid block → caller falls back to XLA
+
+
+def _grid_params(interpret: bool):
+    kw = {"interpret": interpret}
+    if _HAS_PLTPU and not interpret:
+        kw["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        )
+    return kw
+
+
+def _flash_fwd_call(q, k, v, causal, sm_scale, bq, bk, interpret):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    nq, nk = sq // bq, skv // bk
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b_, h_, iq, ik: (b_, h_, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
+        scratch_shapes=[_vmem((bq, d)), _vmem((bq, 128)), _vmem((bq, 128))],
+        **_grid_params(interpret),
+    )(q, k, v)
+    return out, lse
+
+
+def _flash_bwd_call(q, k, v, out, lse, do, causal, sm_scale, bq, bk, interpret):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    nq, nk = sq // bq, skv // bk
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b_, h_, iq, ik: (b_, h_, iq)),
+            pl.BlockSpec((1, 1, bq), lambda b_, h_, iq, ik: (b_, h_, iq)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[_vmem((bq, d))],
+        **_grid_params(interpret),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nq=nq),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b_, h_, ik, iq: (b_, h_, iq)),
+            pl.BlockSpec((1, 1, bq), lambda b_, h_, ik, iq: (b_, h_, iq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[_vmem((bk, d)), _vmem((bk, d))],
+        **_grid_params(interpret),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _vmem(shape):
+    if not _HAS_PLTPU:  # pragma: no cover
+        raise RuntimeError("pallas TPU memory spaces unavailable in this jaxlib build")
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP core (MHA; q/k/v all [B, H, S, D] with equal H)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_mha(q, k, v, causal, sm_scale, bq, bk, interpret):
+    out, _ = _flash_fwd_call(q, k, v, causal, sm_scale, bq, bk, interpret)
+    return out
+
+
+def _flash_mha_fwd(q, k, v, causal, sm_scale, bq, bk, interpret):
+    out, lse = _flash_fwd_call(q, k, v, causal, sm_scale, bq, bk, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_mha_bwd(causal, sm_scale, bq, bk, interpret, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd_call(q, k, v, out, lse, do, causal, sm_scale, bq, bk, interpret)
+    return dq, dk, dv
+
+
+_flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_kv: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas flash attention. q: [B, H, Sq, D]; k/v: [B, KVH, Skv, D]
+    (KVH must divide H; kv heads are broadcast across the query group, and
+    their gradients sum back automatically through the broadcast)."""
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    h, kvh = q.shape[1], k.shape[1]
+    if kvh != h:
+        if h % kvh:
+            raise ValueError(f"query heads ({h}) must be a multiple of kv heads ({kvh})")
+        k = jnp.repeat(k, h // kvh, axis=1)
+        v = jnp.repeat(v, h // kvh, axis=1)
+    bq = _pick_block(q.shape[2], block_q)
+    bk = _pick_block(k.shape[2], block_kv)
+    if not bq or not bk:
+        raise ValueError(
+            f"sequence lengths ({q.shape[2]}, {k.shape[2]}) need a 128-multiple block; "
+            "pad inputs or use dot_product_attention (auto-fallback)"
+        )
+    return _flash_mha(q, k, v, causal, sm_scale, bq, bk, interpret)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    impl: str = "auto",
+    interpret: bool = False,
+) -> jax.Array:
+    """Attention dispatcher: pallas flash kernel on TPU when shapes allow,
+    XLA reference otherwise. Layout [B, H, S, D]. ``impl`` ∈
+    {"auto", "flash", "xla"}."""
+    if impl == "xla":
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    on_tpu = jax.default_backend() == "tpu"
+    blocks_ok = (
+        _pick_block(q.shape[2], 512) and _pick_block(k.shape[2], 512) and q.shape[-1] % 128 == 0
+    )
+    if impl == "flash" or (impl == "auto" and (on_tpu or interpret) and blocks_ok):
+        return flash_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale, interpret=interpret or not on_tpu
+        )
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
