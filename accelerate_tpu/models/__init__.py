@@ -1,0 +1,18 @@
+"""Model families shipped with the framework.
+
+The reference repo wraps user-supplied torch models; on TPU the model *is*
+part of the performance story (logical-axis annotations drive GSPMD
+sharding, remat policy drives HBM, pallas attention drives the hot loop),
+so we ship first-class implementations:
+
+- ``DecoderLM`` — LLaMA-family causal LM (RMSNorm/RoPE/SwiGLU/GQA),
+  the flagship training model (maps to reference GPT benchmarks).
+- ``EncoderClassifier`` — BERT-family sequence classifier
+  (reference `examples/nlp_example.py` target, BASELINE.md).
+"""
+
+from .configs import DecoderConfig, EncoderConfig
+from .decoder import DecoderLM
+from .encoder import EncoderClassifier
+
+__all__ = ["DecoderConfig", "EncoderConfig", "DecoderLM", "EncoderClassifier"]

@@ -1,0 +1,135 @@
+"""Model configurations + size presets."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclass
+class DecoderConfig:
+    """LLaMA-family causal LM config.
+
+    ``attention_impl``: "auto" (pallas flash on TPU, XLA elsewhere),
+    "flash", or "xla". ``remat``: checkpoint each block (trades FLOPs for
+    HBM — the reference's FSDP activation-checkpointing analog,
+    /root/reference/src/accelerate/accelerator.py:1485-1499).
+    ``scan_layers``: roll blocks into one lax.scan — O(1) compile time in
+    depth and a requirement for pipeline-stage splitting later.
+    """
+
+    vocab_size: int = 32_000
+    num_layers: int = 12
+    embed_dim: int = 768
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # None -> MHA
+    head_dim: Optional[int] = None  # None -> embed_dim // num_heads
+    mlp_dim: Optional[int] = None  # None -> ~8/3 * embed, rounded to 256
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-6
+    tie_embeddings: bool = True
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16  # compute dtype for activations
+    attention_impl: str = "auto"
+    remat: bool = True
+    scan_layers: bool = True
+    fused_ce_chunks: int = 8
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.head_dim is None:
+            self.head_dim = self.embed_dim // self.num_heads
+        if self.mlp_dim is None:
+            raw = int(self.embed_dim * 8 / 3)
+            self.mlp_dim = (raw + 255) // 256 * 256
+
+    @property
+    def num_params(self) -> int:
+        """Parameter count (for estimate CLI / MFU math)."""
+        e, h, kv, d, m, v = (
+            self.embed_dim,
+            self.num_heads,
+            self.num_kv_heads,
+            self.head_dim,
+            self.mlp_dim,
+            self.vocab_size,
+        )
+        attn = e * h * d + 2 * e * kv * d + h * d * e
+        mlp = 3 * e * m
+        norms = 2 * e
+        per_layer = attn + mlp + norms
+        embed = v * e
+        head = 0 if self.tie_embeddings else e * v
+        return self.num_layers * per_layer + embed + head + e  # + final norm
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Test-size model (runs on the 8-device CPU sim)."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("embed_dim", 64)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("mlp_dim", 128)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("dtype", jnp.float32)
+        kw.setdefault("remat", False)
+        return cls(**kw)
+
+    @classmethod
+    def small_1b(cls, **kw):
+        """~1.2B bench model (fits one v5e chip in bf16 + Adam fp32)."""
+        kw.setdefault("vocab_size", 32_000)
+        kw.setdefault("num_layers", 16)
+        kw.setdefault("embed_dim", 2048)
+        kw.setdefault("num_heads", 16)
+        kw.setdefault("num_kv_heads", 8)
+        kw.setdefault("max_seq_len", 2048)
+        return cls(**kw)
+
+    @classmethod
+    def llama_7b(cls, **kw):
+        kw.setdefault("vocab_size", 32_000)
+        kw.setdefault("num_layers", 32)
+        kw.setdefault("embed_dim", 4096)
+        kw.setdefault("num_heads", 32)
+        kw.setdefault("mlp_dim", 11_008)
+        kw.setdefault("max_seq_len", 4096)
+        kw.setdefault("tie_embeddings", False)
+        return cls(**kw)
+
+
+@dataclass
+class EncoderConfig:
+    """BERT-family encoder config (reference nlp_example target)."""
+
+    vocab_size: int = 30_522
+    num_layers: int = 12
+    embed_dim: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    dropout_rate: float = 0.1
+    norm_eps: float = 1e-12
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("embed_dim", 64)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("mlp_dim", 128)
+        kw.setdefault("max_seq_len", 64)
+        kw.setdefault("dtype", jnp.float32)
+        return cls(**kw)
+
+    @classmethod
+    def bert_base(cls, **kw):
+        return cls(**kw)

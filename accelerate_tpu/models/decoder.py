@@ -1,0 +1,230 @@
+"""LLaMA-family causal decoder, written mesh-first.
+
+Every parameter carries logical axis names (`nn.with_logical_partitioning`)
+that `parallel/sharding.py` maps onto the device mesh — TP shards heads/mlp
+over "tensor", ZeRO shards embed over "fsdp", and activations are pinned
+with sharding constraints so GSPMD propagates the layout instead of
+guessing. Blocks optionally roll into one `lax.scan` (O(1) compile time in
+depth) with `jax.checkpoint` remat per block (the activation-checkpointing
+analog of reference accelerator.py:1485-1499).
+
+The reference has no in-repo model code (it wraps user torch models); this
+file is the "what users actually run" counterpart to its GPT/BERT example
+targets (reference examples/nlp_example.py, benchmarks/big_model_inference).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+
+from ..ops.attention import dot_product_attention
+from ..ops.layers import apply_rotary_embedding, rms_norm, rotary_embedding_tables, swiglu
+from ..ops.losses import fused_linear_cross_entropy
+from ..parallel.sharding import DEFAULT_AXIS_RULES, logical_to_spec
+from .configs import DecoderConfig
+
+
+def _constrain(x, names, mesh: Optional[Mesh], rules=DEFAULT_AXIS_RULES):
+    """Pin an activation's sharding (no-op without a multi-device mesh).
+
+    Mesh axes that don't divide the actual dim are dropped (a batch of 1 at
+    init/eval time must not demand fsdp-divisibility)."""
+    if mesh is None or mesh.size == 1:
+        return x
+    from jax.sharding import PartitionSpec as P
+
+    spec = logical_to_spec(names, rules, mesh)
+    parts = []
+    for i, dim in enumerate(x.shape):
+        entry = spec[i] if i < len(spec) else None
+        if entry is None:
+            parts.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept, prod = [], 1
+        for ax in axes:
+            n = mesh.shape[ax]
+            if dim % (prod * n) == 0:
+                kept.append(ax)
+                prod *= n
+        parts.append(tuple(kept) if len(kept) > 1 else (kept[0] if kept else None))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*parts)))
+
+
+def _dense_init(scale: float = 1.0):
+    return nn.initializers.variance_scaling(scale, "fan_in", "normal")
+
+
+class DecoderAttention(nn.Module):
+    config: DecoderConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, sin, cos, deterministic: bool = True):
+        cfg = self.config
+        e, h, kv, d = cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        wq = self.param("wq", nn.with_logical_partitioning(_dense_init(), ("embed", "heads", "head_dim")), (e, h, d))
+        wk = self.param("wk", nn.with_logical_partitioning(_dense_init(), ("embed", "kv_heads", "head_dim")), (e, kv, d))
+        wv = self.param("wv", nn.with_logical_partitioning(_dense_init(), ("embed", "kv_heads", "head_dim")), (e, kv, d))
+        wo = self.param("wo", nn.with_logical_partitioning(_dense_init(), ("heads", "head_dim", "embed")), (h, d, e))
+
+        dt = cfg.dtype
+        q = jnp.einsum("bse,ehd->bhsd", x, wq.astype(dt))
+        k = jnp.einsum("bse,ehd->bhsd", x, wk.astype(dt))
+        v = jnp.einsum("bse,ehd->bhsd", x, wv.astype(dt))
+        q = _constrain(q, ("batch", "heads", "seq", "head_dim"), self.mesh)
+        k = _constrain(k, ("batch", "kv_heads", "seq", "head_dim"), self.mesh)
+        q = apply_rotary_embedding(q, sin, cos)
+        k = apply_rotary_embedding(k, sin, cos)
+        out = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+        out = _constrain(out, ("batch", "heads", "seq", "head_dim"), self.mesh)
+        out = jnp.einsum("bhsd,hde->bse", out, wo.astype(dt))
+        return _constrain(out, ("batch", "seq", "embed"), self.mesh)
+
+
+class DecoderMLP(nn.Module):
+    config: DecoderConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        e, m = cfg.embed_dim, cfg.mlp_dim
+        wg = self.param("w_gate", nn.with_logical_partitioning(_dense_init(), ("embed", "mlp")), (e, m))
+        wu = self.param("w_up", nn.with_logical_partitioning(_dense_init(), ("embed", "mlp")), (e, m))
+        wd = self.param("w_down", nn.with_logical_partitioning(_dense_init(), ("mlp", "embed")), (m, e))
+        dt = cfg.dtype
+        gate = x @ wg.astype(dt)
+        up = x @ wu.astype(dt)
+        hidden = _constrain(swiglu(gate, up), ("batch", "seq", "mlp"), self.mesh)
+        return _constrain(hidden @ wd.astype(dt), ("batch", "seq", "embed"), self.mesh)
+
+
+class DecoderBlock(nn.Module):
+    config: DecoderConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, sin, cos, deterministic: bool = True):
+        cfg = self.config
+        ln1 = self.param("ln_attn", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
+        ln2 = self.param("ln_mlp", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
+        y = rms_norm(x, ln1, cfg.norm_eps)
+        y = DecoderAttention(cfg, self.mesh, name="attn")(y, sin, cos, deterministic)
+        if cfg.dropout_rate > 0.0:
+            y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
+        x = x + y
+        y = rms_norm(x, ln2, cfg.norm_eps)
+        y = DecoderMLP(cfg, self.mesh, name="mlp")(y)
+        if cfg.dropout_rate > 0.0:
+            y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
+        return x + y
+
+
+class _ScanBlock(nn.Module):
+    """DecoderBlock adapted to lax.scan carry protocol."""
+
+    config: DecoderConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, sin, cos, deterministic = carry
+        x = DecoderBlock(self.config, self.mesh, name="block")(x, sin, cos, deterministic)
+        return (x, sin, cos, deterministic), None
+
+
+class DecoderLM(nn.Module):
+    """Causal LM. __call__(input_ids[, labels]) -> {"logits"|"loss", ...}.
+
+    When ``labels`` is given, logits are never materialized — the fused
+    chunked LM-head CE (ops/losses.py) runs instead.
+    """
+
+    config: DecoderConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        labels: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        b, s = input_ids.shape
+        embedding = self.param(
+            "embedding",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.embed_dim),
+        )
+        x = jnp.take(embedding, input_ids, axis=0).astype(cfg.dtype)
+        x = _constrain(x, ("batch", "seq", "embed"), self.mesh)
+
+        if positions is None:
+            positions = jnp.arange(s)
+        sin, cos = rotary_embedding_tables(positions, cfg.head_dim, theta=cfg.rope_theta, dtype=cfg.dtype)
+
+        block_cls = DecoderBlock
+        if cfg.scan_layers:
+            scan_body = _ScanBlock
+            if cfg.remat:
+                scan_body = nn.remat(
+                    scan_body,
+                    prevent_cse=False,
+                    static_argnums=(),
+                )
+            ScanStack = nn.scan(
+                scan_body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layer"},
+            )
+            (x, _, _, _), _ = ScanStack(cfg, self.mesh, name="layers")(
+                (x, sin, cos, deterministic), None
+            )
+        else:
+            if cfg.remat:
+                block_cls = nn.remat(DecoderBlock, prevent_cse=True)
+            for i in range(cfg.num_layers):
+                x = block_cls(cfg, self.mesh, name=f"layer_{i}")(x, sin, cos, deterministic)
+
+        ln_f = self.param("ln_final", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
+        x = rms_norm(x, ln_f, cfg.norm_eps)
+
+        if cfg.tie_embeddings:
+            vocab_kernel = embedding.T.astype(cfg.dtype)
+        else:
+            vocab_kernel = self.param(
+                "lm_head",
+                nn.with_logical_partitioning(_dense_init(), ("embed", "vocab")),
+                (cfg.embed_dim, cfg.vocab_size),
+            ).astype(cfg.dtype)
+
+        if labels is not None:
+            # HF convention: labels == input_ids, shifted internally so
+            # position i predicts token i+1.
+            hidden = x[:, :-1].reshape(b * (s - 1), cfg.embed_dim)
+            targets = labels[:, 1:].reshape(b * (s - 1))
+            loss = fused_linear_cross_entropy(
+                hidden,
+                vocab_kernel,
+                targets,
+                ignore_index=-100,
+                num_chunks=cfg.fused_ce_chunks,
+            )
+            return {"loss": loss}
+        logits = (x @ vocab_kernel).astype(jnp.float32)
+        return {"logits": _constrain(logits, ("batch", "seq", "vocab"), self.mesh)}
+
+    def init_variables(self, rng: jax.Array, batch_size: int = 1, seq_len: Optional[int] = None):
+        seq_len = seq_len or min(self.config.max_seq_len, 128)
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)

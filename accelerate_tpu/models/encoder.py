@@ -1,0 +1,153 @@
+"""BERT-family encoder + sequence-classification head.
+
+Parity target: the model used by the reference's canonical example
+(/root/reference/examples/nlp_example.py — bert-base-cased on MRPC), whose
+samples/sec/chip + MFU is the BASELINE.md training benchmark. Bidirectional
+attention with a padding mask (routes to the XLA attention path), GELU MLP,
+LayerNorm. Params carry the same logical axes as the decoder so all the
+mesh strategies apply unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops.attention import dot_product_attention
+from ..ops.losses import softmax_cross_entropy
+from .configs import EncoderConfig
+from .decoder import _constrain, _dense_init
+
+
+def _layer_norm(x, scale, bias, eps):
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+class EncoderBlock(nn.Module):
+    config: EncoderConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, bias, deterministic: bool = True):
+        cfg = self.config
+        e, h = cfg.embed_dim, cfg.num_heads
+        d = e // h
+        wq = self.param("wq", nn.with_logical_partitioning(_dense_init(), ("embed", "heads", "head_dim")), (e, h, d))
+        wk = self.param("wk", nn.with_logical_partitioning(_dense_init(), ("embed", "heads", "head_dim")), (e, h, d))
+        wv = self.param("wv", nn.with_logical_partitioning(_dense_init(), ("embed", "heads", "head_dim")), (e, h, d))
+        wo = self.param("wo", nn.with_logical_partitioning(_dense_init(), ("heads", "head_dim", "embed")), (h, d, e))
+        ln1_s = self.param("ln1_scale", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (e,))
+        ln1_b = self.param("ln1_bias", nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)), (e,))
+        ln2_s = self.param("ln2_scale", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (e,))
+        ln2_b = self.param("ln2_bias", nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)), (e,))
+
+        dt = cfg.dtype
+        q = jnp.einsum("bse,ehd->bhsd", x, wq.astype(dt))
+        k = jnp.einsum("bse,ehd->bhsd", x, wk.astype(dt))
+        v = jnp.einsum("bse,ehd->bhsd", x, wv.astype(dt))
+        attn = dot_product_attention(q, k, v, causal=False, bias=bias)
+        attn = jnp.einsum("bhsd,hde->bse", attn, wo.astype(dt))
+        if cfg.dropout_rate > 0.0:
+            attn = nn.Dropout(cfg.dropout_rate)(attn, deterministic=deterministic)
+        x = _layer_norm(x + attn, ln1_s, ln1_b, cfg.norm_eps)
+        x = _constrain(x, ("batch", "seq", "embed"), self.mesh)
+
+        wi = self.param("w_in", nn.with_logical_partitioning(_dense_init(), ("embed", "mlp")), (e, cfg.mlp_dim))
+        bi = self.param("b_in", nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)), (cfg.mlp_dim,))
+        wo2 = self.param("w_out", nn.with_logical_partitioning(_dense_init(), ("mlp", "embed")), (cfg.mlp_dim, e))
+        bo2 = self.param("b_out", nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)), (e,))
+        hidden = jax.nn.gelu(x @ wi.astype(dt) + bi.astype(dt))
+        hidden = _constrain(hidden, ("batch", "seq", "mlp"), self.mesh)
+        out = hidden @ wo2.astype(dt) + bo2.astype(dt)
+        if cfg.dropout_rate > 0.0:
+            out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
+        x = _layer_norm(x + out, ln2_s, ln2_b, cfg.norm_eps)
+        return _constrain(x, ("batch", "seq", "embed"), self.mesh)
+
+
+class EncoderClassifier(nn.Module):
+    """__call__(input_ids, attention_mask, token_type_ids[, labels])
+    -> {"logits"[, "loss"]} — HF AutoModelForSequenceClassification shape."""
+
+    config: EncoderConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        token_type_ids: Optional[jax.Array] = None,
+        labels: Optional[jax.Array] = None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        b, s = input_ids.shape
+        word = self.param(
+            "word_embedding",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.embed_dim),
+        )
+        pos = self.param(
+            "position_embedding",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02), ("seq", "embed")),
+            (cfg.max_seq_len, cfg.embed_dim),
+        )
+        typ = self.param(
+            "type_embedding",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.type_vocab_size, cfg.embed_dim),
+        )
+        ln_s = self.param("ln_embed_scale", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
+        ln_b = self.param("ln_embed_bias", nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)), (cfg.embed_dim,))
+
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (
+            jnp.take(word, input_ids, axis=0)
+            + pos[None, :s]
+            + jnp.take(typ, token_type_ids, axis=0)
+        )
+        x = _layer_norm(x.astype(cfg.dtype), ln_s, ln_b, cfg.norm_eps)
+        x = _constrain(x, ("batch", "seq", "embed"), self.mesh)
+        if cfg.dropout_rate > 0.0:
+            x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+
+        bias = None
+        if attention_mask is not None:
+            bias = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+
+        body = EncoderBlock
+        if cfg.remat:
+            body = nn.remat(EncoderBlock, prevent_cse=True)
+        for i in range(cfg.num_layers):
+            x = body(cfg, self.mesh, name=f"layer_{i}")(x, bias, deterministic)
+
+        # BERT pooler: tanh(dense(CLS))
+        wp = self.param("pooler_kernel", nn.with_logical_partitioning(_dense_init(), ("embed", "embed")), (cfg.embed_dim, cfg.embed_dim))
+        bp = self.param("pooler_bias", nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)), (cfg.embed_dim,))
+        pooled = jnp.tanh(x[:, 0] @ wp.astype(cfg.dtype) + bp.astype(cfg.dtype))
+        if cfg.dropout_rate > 0.0:
+            pooled = nn.Dropout(cfg.dropout_rate)(pooled, deterministic=deterministic)
+
+        wc = self.param("classifier_kernel", nn.with_logical_partitioning(_dense_init(), ("embed", None)), (cfg.embed_dim, cfg.num_labels))
+        bc = self.param("classifier_bias", nn.with_logical_partitioning(nn.initializers.zeros, (None,)), (cfg.num_labels,))
+        logits = (pooled @ wc.astype(cfg.dtype) + bc.astype(cfg.dtype)).astype(jnp.float32)
+        out = {"logits": logits}
+        if labels is not None:
+            out["loss"] = softmax_cross_entropy(logits, labels)
+        return out
+
+    def init_variables(self, rng: jax.Array, batch_size: int = 1, seq_len: Optional[int] = None):
+        seq_len = seq_len or min(self.config.max_seq_len, 64)
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)

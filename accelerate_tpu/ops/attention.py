@@ -52,8 +52,11 @@ def mha_reference(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Plain-XLA attention. q: [B, H, Sq, D]; k/v: [B, KVH, Skv, D]."""
+    """Plain-XLA attention. q: [B, H, Sq, D]; k/v: [B, KVH, Skv, D].
+    ``bias`` is additive, broadcastable to [B, H, Sq, Skv] (use large
+    negatives for padding masks)."""
     orig_dtype = q.dtype
     sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     b, h, sq, d = q.shape
@@ -65,6 +68,11 @@ def mha_reference(
     else:
         s = jnp.einsum("bhqd,bhcd->bhqc", q, k, preferred_element_type=jnp.float32)
     s = s * sm_scale
+    if bias is not None:
+        bias32 = jnp.broadcast_to(bias.astype(jnp.float32), (b, h, sq, k.shape[2]))
+        if kvh != h:
+            bias32 = bias32.reshape(b, kvh, group, sq, k.shape[2])
+        s = s + bias32
     if causal:
         skv = k.shape[2]
         mask = jnp.tril(jnp.ones((sq, skv), dtype=bool), k=skv - sq)
@@ -127,7 +135,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, sm_sc
         m = m_scr[...][:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc[...] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m + jnp.log(safe_l))[:, 0]
+        # TPU tiling: lse lives as [B, H, 8, Sq] (one f32 sublane tile);
+        # row 0 is the value, rows 1-7 are padding.
+        lse_ref[0, 0] = jnp.broadcast_to((m + jnp.log(safe_l))[:, 0][None, :], lse_ref.shape[2:])
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, sm_scale, causal, bq, bk, nk):
@@ -145,8 +155,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, 
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0, 0][:, None]
+        delta = delta_ref[0, 0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * sm_scale
         if causal:
             rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -180,8 +190,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0, 0][:, None]
+        delta = delta_ref[0, 0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * sm_scale
         if causal:
             rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -241,11 +251,11 @@ def _flash_fwd_call(q, k, v, causal, sm_scale, bq, bk, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b_, h_, iq, ik: (b_, h_, iq)),
+            pl.BlockSpec((1, 1, 8, bq), lambda b_, h_, iq, ik: (b_, h_, 0, iq)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 8, sq), jnp.float32),
         ],
         scratch_shapes=[_vmem((bq, d)), _vmem((bq, 128)), _vmem((bq, 128))],
         **_grid_params(interpret),
@@ -257,7 +267,9 @@ def _flash_bwd_call(q, k, v, out, lse, do, causal, sm_scale, bq, bk, interpret):
     b, h, sq, d = q.shape
     skv = k.shape[2]
     nq, nk = sq // bq, skv // bk
+    lse = jnp.broadcast_to(lse, (b, h, 8, sq))  # residual stored [B,H,1,Sq]
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+    delta = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, sq))  # sublane-tile layout
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk),
@@ -267,8 +279,8 @@ def _flash_bwd_call(q, k, v, out, lse, do, causal, sm_scale, bq, bk, interpret):
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b_, h_, iq, ik: (b_, h_, iq)),
-            pl.BlockSpec((1, 1, bq), lambda b_, h_, iq, ik: (b_, h_, iq)),
+            pl.BlockSpec((1, 1, 8, bq), lambda b_, h_, iq, ik: (b_, h_, 0, iq)),
+            pl.BlockSpec((1, 1, 8, bq), lambda b_, h_, iq, ik: (b_, h_, 0, iq)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -284,8 +296,8 @@ def _flash_bwd_call(q, k, v, out, lse, do, causal, sm_scale, bq, bk, interpret):
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b_, h_, ik, iq: (b_, h_, iq)),
-            pl.BlockSpec((1, 1, bq), lambda b_, h_, ik, iq: (b_, h_, iq)),
+            pl.BlockSpec((1, 1, 8, bq), lambda b_, h_, ik, iq: (b_, h_, 0, iq)),
+            pl.BlockSpec((1, 1, 8, bq), lambda b_, h_, ik, iq: (b_, h_, 0, iq)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
@@ -320,7 +332,8 @@ def _flash_mha(q, k, v, causal, sm_scale, bq, bk, interpret):
 
 def _flash_mha_fwd(q, k, v, causal, sm_scale, bq, bk, interpret):
     out, lse = _flash_fwd_call(q, k, v, causal, sm_scale, bq, bk, interpret)
-    return out, (q, k, v, out, lse)
+    # keep only the value row of the [B,H,8,Sq] tile layout as the residual
+    return out, (q, k, v, out, lse[:, :, :1])
 
 
 def _flash_mha_bwd(causal, sm_scale, bq, bk, interpret, res, do):
@@ -370,14 +383,19 @@ def dot_product_attention(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
     impl: str = "auto",
     interpret: bool = False,
 ) -> jax.Array:
     """Attention dispatcher: pallas flash kernel on TPU when shapes allow,
     XLA reference otherwise. Layout [B, H, S, D]. ``impl`` ∈
-    {"auto", "flash", "xla"}."""
-    if impl == "xla":
-        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    {"auto", "flash", "xla"}. A ``bias`` (padding mask) routes to the XLA
+    path — the kernel handles the causal mask only; asking for "flash" with
+    a bias is an error rather than a silent downgrade."""
+    if impl == "flash" and bias is not None:
+        raise ValueError("flash impl does not support bias; use impl='auto' or 'xla'")
+    if impl == "xla" or bias is not None:
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)
     on_tpu = jax.default_backend() == "tpu"
     blocks_ok = (
         _pick_block(q.shape[2], 512) and _pick_block(k.shape[2], 512) and q.shape[-1] % 128 == 0

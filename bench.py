@@ -1,0 +1,123 @@
+"""Training-throughput benchmark on the flagship decoder.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no training-throughput numbers (BASELINE.md); the
+driver's north star is >=45% MFU, so vs_baseline = MFU / 0.45. On a real
+TPU chip this trains a ~390M-param LLaMA-style model in bf16 (pallas flash
+attention, fused-CE loss, remat+scan); on CPU it falls back to a tiny model
+so the harness always produces a number.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak FLOP/s per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5": 459e12,  # v5p
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+    "TPU v6e": 918e12,
+    "TPU v7": 2307e12,  # Ironwood (bf16)
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    # most-specific (longest) name first: "TPU v5 lite" must win over "TPU v5"
+    for name, flops in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if name.lower() in kind:
+            return flops
+    return 200e12  # conservative default for unknown TPU; CPU runs report vs this
+
+
+def main():
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import DecoderConfig, DecoderLM
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = DecoderConfig(
+            vocab_size=32_000,
+            num_layers=12,
+            embed_dim=1536,
+            num_heads=12,
+            num_kv_heads=12,
+            mlp_dim=4096,
+            max_seq_len=2048,
+            dtype=jnp.bfloat16,
+            remat=True,
+            scan_layers=True,
+        )
+        batch_size, seq_len, steps = 8, 2048, 20
+    else:
+        cfg = DecoderConfig.tiny(max_seq_len=256)
+        batch_size, seq_len, steps = 4, 128, 5
+
+    accelerator = Accelerator(mixed_precision="bf16" if on_tpu else "no")
+    model_def = DecoderLM(cfg, mesh=accelerator.mesh)
+    variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=batch_size, seq_len=seq_len)
+    model, optimizer = accelerator.prepare(
+        Model(model_def, variables),
+        optax.adamw(optax.warmup_cosine_decay_schedule(0.0, 3e-4, 100, 1000)),
+    )
+    step = accelerator.build_train_step()
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch_size, seq_len))
+    batch = accelerator.prepare_for_eval({"input_ids": ids, "labels": ids})
+
+    # warmup / compile. NB: device_get, not block_until_ready — the latter
+    # does not actually block through remote-attached runtimes, and the
+    # final loss value transitively depends on every timed step.
+    for _ in range(2):
+        metrics = step(batch)
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        metrics = step(batch)
+    final_loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
+
+    tokens = batch_size * seq_len * steps
+    tokens_per_sec = tokens / dt
+    n_params = cfg.num_params
+    # FLOPs/token: 6N weight FLOPs + causal attention 6*L*S*E
+    flops_per_token = 6 * n_params + 6 * cfg.num_layers * seq_len * cfg.embed_dim
+    achieved = tokens_per_sec * flops_per_token
+    peak = _peak_flops(jax.devices()[0])
+    mfu = achieved / peak
+
+    print(
+        f"[bench] backend={jax.default_backend()} params={n_params/1e6:.0f}M "
+        f"tokens/s={tokens_per_sec:,.0f} step_time={dt/steps*1e3:.1f}ms "
+        f"achieved={achieved/1e12:.1f}TF/s peak={peak/1e12:.0f}TF/s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "decoder_train_mfu",
+                "value": round(mfu * 100, 2),
+                "unit": "percent_of_peak_bf16",
+                "vs_baseline": round(mfu / 0.45, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
