@@ -81,7 +81,12 @@ class DecoderAttention(nn.Module):
         k = _constrain(k, ("batch", "kv_heads", "seq", "head_dim"), self.mesh)
         q = apply_rotary_embedding(q, sin, cos)
         k = apply_rotary_embedding(k, sin, cos)
-        out = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+        if self.mesh is not None and self.mesh.shape.get("sequence", 1) > 1:
+            from ..parallel.context import ring_attention_sharded
+
+            out = ring_attention_sharded(q, k, v, self.mesh, causal=True)
+        else:
+            out = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
         out = _constrain(out, ("batch", "heads", "seq", "head_dim"), self.mesh)
         out = jnp.einsum("bhsd,hde->bse", out, wo.astype(dt))
         return _constrain(out, ("batch", "seq", "embed"), self.mesh)
