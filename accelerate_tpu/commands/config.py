@@ -1,0 +1,71 @@
+"""`accelerate-tpu config` — questionnaire writing the default yaml
+(parity: reference commands/config/{config,cluster,default}.py)."""
+
+from __future__ import annotations
+
+import os
+
+from .config_args import ClusterConfig, default_config_file
+
+
+def register(subparsers):
+    parser = subparsers.add_parser("config", help="Create the launch config file")
+    parser.add_argument("--config_file", default=None)
+    sub = parser.add_subparsers(dest="config_subcommand")
+    default_p = sub.add_parser("default", help="Write a non-interactive default config")
+    default_p.add_argument("--config_file", default=None)
+    default_p.add_argument("--mixed_precision", default="no", choices=["no", "fp16", "bf16"])
+    default_p.set_defaults(func=default_command)
+    parser.set_defaults(func=config_command)
+    return parser
+
+
+def _ask(question: str, default, cast=str, choices=None):
+    suffix = f" [{'/'.join(map(str, choices))}]" if choices else ""
+    raw = input(f"{question}{suffix} ({default}): ").strip()
+    if not raw:
+        return default
+    value = cast(raw)
+    if choices and value not in choices:
+        print(f"  -> {value!r} not in {choices}, keeping {default!r}")
+        return default
+    return value
+
+
+def config_command(args) -> int:
+    """Interactive flow (reference cluster.py questionnaire, TPU-sized:
+    no GPU-vendor questions, sharding degrees instead of plugin choices)."""
+    cfg = ClusterConfig()
+    cfg.compute_environment = _ask(
+        "Compute environment", "LOCAL_MACHINE", str, ["LOCAL_MACHINE", "TPU_POD"]
+    )
+    if cfg.compute_environment == "TPU_POD":
+        cfg.tpu_name = _ask("TPU pod name", "") or None
+        cfg.tpu_zone = _ask("TPU zone", "") or None
+        cfg.num_processes = _ask("Number of hosts in the pod", 1, int)
+    else:
+        cfg.num_processes = _ask("Number of processes (hosts)", 1, int)
+    cfg.mixed_precision = _ask("Mixed precision", "bf16", str, ["no", "fp16", "bf16"])
+    cfg.sharding_strategy = _ask(
+        "Sharding strategy", "AUTO", str, ["AUTO", "DDP", "FSDP", "HYBRID", "GRAD_OP", "NONE"]
+    )
+    cfg.fsdp = _ask("FSDP (ZeRO) axis degree (-1 = all devices)", 1, int)
+    cfg.tensor_parallel = _ask("Tensor-parallel degree", 1, int)
+    cfg.sequence_parallel = _ask("Sequence-parallel (ring attention) degree", 1, int)
+    cfg.data_parallel = _ask("Data-parallel degree (-1 = remaining devices)", -1, int)
+
+    path = args.config_file or default_config_file()
+    cfg.to_yaml_file(path)
+    print(f"accelerate-tpu configuration saved at {path}")
+    return 0
+
+
+def default_command(args) -> int:
+    cfg = ClusterConfig(mixed_precision=args.mixed_precision)
+    path = args.config_file or default_config_file()
+    if os.path.isfile(path):
+        print(f"Config file already exists at {path}, skipping")
+        return 0
+    cfg.to_yaml_file(path)
+    print(f"accelerate-tpu default config saved at {path}")
+    return 0

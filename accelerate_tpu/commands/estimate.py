@@ -1,0 +1,103 @@
+"""`accelerate-tpu estimate` — memory needed to load/train a model
+(parity: reference commands/estimate.py:309 — meta-load + dtype table incl.
+training with Adam x4; TPU version adds per-chip fit given a mesh size).
+
+Sources: a built-in model preset (decoder:small_1b etc.), a local
+checkpoint (safetensors/sharded), or explicit --params count. Zero-egress:
+no Hub downloads."""
+
+from __future__ import annotations
+
+import json
+import os
+
+DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1, "int4": 0.5}
+
+
+def register(subparsers):
+    parser = subparsers.add_parser("estimate", help="Estimate model memory usage")
+    parser.add_argument("model", help="preset (decoder:tiny|decoder:small_1b|decoder:llama_7b|encoder:bert_base), checkpoint path, or param count like 7B")
+    parser.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"], choices=list(DTYPE_BYTES))
+    parser.add_argument("--num_chips", type=int, default=1, help="Mesh size to report per-chip shares")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.set_defaults(func=estimate_command)
+    return parser
+
+
+def _num_params(model: str) -> tuple[int, str]:
+    if ":" in model and not os.path.exists(model):
+        family, preset = model.split(":", 1)
+        if family == "decoder":
+            from ..models import DecoderConfig
+
+            cfg = getattr(DecoderConfig, preset)() if hasattr(DecoderConfig, preset) else None
+            if cfg is None:
+                raise SystemExit(f"unknown decoder preset {preset!r}")
+            return cfg.num_params, model
+        if family == "encoder":
+            from ..models import EncoderClassifier, EncoderConfig
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            cfg = getattr(EncoderConfig, preset)() if hasattr(EncoderConfig, preset) else None
+            if cfg is None:
+                raise SystemExit(f"unknown encoder preset {preset!r}")
+            abstract = jax.eval_shape(
+                lambda: EncoderClassifier(cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+            )
+            n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(abstract))
+            return n, model
+        raise SystemExit(f"unknown model family {family!r}")
+    if os.path.exists(model):
+        from ..utils.serialization import load_flat_dict
+        import numpy as np
+
+        flat = load_flat_dict(model)
+        return sum(int(np.prod(v.shape)) for v in flat.values()), model
+    # "7B" / "350M" style
+    suffixes = {"K": 1e3, "M": 1e6, "B": 1e9, "T": 1e12}
+    s = model.upper().rstrip()
+    if s and s[-1] in suffixes:
+        return int(float(s[:-1]) * suffixes[s[-1]]), model
+    raise SystemExit(f"cannot interpret model spec {model!r}")
+
+
+def _fmt(n_bytes: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n_bytes < 1024 or unit == "TB":
+            return f"{n_bytes:.2f} {unit}"
+        n_bytes /= 1024
+    return f"{n_bytes:.2f} TB"
+
+
+def estimate_command(args) -> int:
+    n, name = _num_params(args.model)
+    rows = []
+    for dtype in args.dtypes:
+        weights = n * DTYPE_BYTES[dtype]
+        # training: params + grads (same dtype) + Adam m/v in fp32 + fp32 master
+        train = weights + n * DTYPE_BYTES[dtype] + n * 4 * 2 + (n * 4 if dtype != "float32" else 0)
+        rows.append(
+            {
+                "dtype": dtype,
+                "params": n,
+                "inference_total": weights,
+                "training_total_adam": train,
+                "inference_per_chip": weights / args.num_chips,
+                "training_per_chip_fsdp": train / args.num_chips,
+            }
+        )
+    if args.as_json:
+        print(json.dumps({"model": name, "rows": rows}))
+        return 0
+    print(f"Memory estimate for {name} ({n/1e6:,.0f}M params, mesh of {args.num_chips} chip(s))")
+    header = f"{'dtype':>9} | {'inference':>12} | {'train (Adam)':>13} | {'infer/chip':>12} | {'train/chip':>12}"
+    print(header)
+    print("-" * len(header))
+    for r in rows:
+        print(
+            f"{r['dtype']:>9} | {_fmt(r['inference_total']):>12} | {_fmt(r['training_total_adam']):>13} "
+            f"| {_fmt(r['inference_per_chip']):>12} | {_fmt(r['training_per_chip_fsdp']):>12}"
+        )
+    return 0

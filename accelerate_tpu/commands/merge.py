@@ -1,0 +1,39 @@
+"""`accelerate-tpu merge-weights` — consolidate a sharded checkpoint into
+one file (parity: reference commands/merge.py:69 over
+torch.distributed.checkpoint; ours reads the sharded-safetensors layout
+written by Accelerator.save_state / save_model)."""
+
+from __future__ import annotations
+
+import os
+
+
+def register(subparsers):
+    parser = subparsers.add_parser(
+        "merge-weights", help="Merge a sharded checkpoint into a single file"
+    )
+    parser.add_argument("checkpoint_dir", help="Directory with model shards (save_state output)")
+    parser.add_argument("output_path", help="Destination .safetensors file")
+    parser.add_argument("--unsafe_serialization", action="store_true", help="Write pickle instead of safetensors")
+    parser.set_defaults(func=merge_command)
+    return parser
+
+
+def merge_command(args) -> int:
+    from ..utils.serialization import load_flat_dict, save_pytree
+
+    src = args.checkpoint_dir
+    # accept either the checkpoint dir itself or one containing model.safetensors*
+    candidates = [src]
+    if os.path.isdir(src):
+        for stem in ("model.safetensors", "model.safetensors.index.json", "model.bin"):
+            p = os.path.join(src, stem)
+            if os.path.exists(p):
+                candidates.insert(0, p)
+                break
+    flat = load_flat_dict(candidates[0])
+    out = args.output_path
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    save_pytree(flat, out, safe_serialization=not args.unsafe_serialization)
+    print(f"merged {len(flat)} tensors from {src} -> {out}")
+    return 0

@@ -1,0 +1,35 @@
+"""`accelerate-tpu test` — run the bundled sanity suite under launch
+(parity: reference commands/test.py:65)."""
+
+from __future__ import annotations
+
+import os
+
+
+def register(subparsers):
+    parser = subparsers.add_parser("test", help="Run the bundled distributed sanity checks")
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--num_processes", type=int, default=None)
+    parser.add_argument("--cpu", action="store_true")
+    parser.set_defaults(func=test_command)
+    return parser
+
+
+def test_command(args) -> int:
+    import accelerate_tpu.test_utils.scripts.test_script as ts
+
+    script = os.path.abspath(ts.__file__)
+    from .accelerate_cli import main as cli_main
+
+    argv = ["launch"]
+    if args.config_file:
+        argv += ["--config_file", args.config_file]
+    if args.num_processes:
+        argv += ["--num_processes", str(args.num_processes)]
+    if args.cpu:
+        argv += ["--cpu"]
+    argv += [script]
+    code = cli_main(argv)
+    if code == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    return code

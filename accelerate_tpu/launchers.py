@@ -1,0 +1,99 @@
+"""notebook_launcher / debug_launcher
+(parity: reference launchers.py, 302 LoC).
+
+The torch version must xmp.spawn 8 processes on TPU (one per core) or fork
+CUDA workers; JAX drives every local chip from ONE process, so
+``notebook_launcher`` on a single host is just "call the function" after
+setting launch env. Multi-process remains for the CPU/gloo debug path and
+multi-host notebooks (each host runs its own kernel).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import textwrap
+from typing import Optional
+
+from .utils.environment import env_var
+
+
+def notebook_launcher(
+    function,
+    args=(),
+    num_processes: Optional[int] = None,
+    mixed_precision: str = "no",
+    use_port: str = "29500",
+    master_addr: str = "127.0.0.1",
+    node_rank: int = 0,
+    num_nodes: int = 1,
+    **kwargs,
+):
+    """Run ``function(*args)`` under the launch env contract.
+
+    - single host (the TPU case): executes inline — one process already
+      sees all chips, nothing to spawn (reference must xmp.spawn instead);
+    - ``num_processes > 1``: spawns CPU/gloo workers like debug_launcher
+      (reference notebook GPU path).
+    """
+    if num_processes is None or num_processes <= 1:
+        os.environ[env_var("MIXED_PRECISION")] = mixed_precision
+        return function(*args)
+    return _spawn_and_run(
+        function, args, num_processes, mixed_precision, master_addr, use_port
+    )
+
+
+def debug_launcher(function, args=(), num_processes: int = 2):
+    """Fork a world of ``num_processes`` CPU workers over gloo-on-localhost
+    (reference debug_launcher:269 — world_size=2 CPU fork)."""
+    return _spawn_and_run(function, args, num_processes, "no", "127.0.0.1", _free_port())
+
+
+def _free_port() -> str:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return str(s.getsockname()[1])
+
+
+_WORKER_TEMPLATE = """
+import pickle, sys
+with open({payload!r}, "rb") as f:
+    function, args = pickle.load(f)
+function(*args)
+"""
+
+
+def _spawn_and_run(function, args, num_processes, mixed_precision, addr, port):
+    """Subprocess spawn (not fork): each worker re-imports and runs the
+    pickled function under the COORDINATOR/PROCESS_ID env contract."""
+    with tempfile.TemporaryDirectory() as td:
+        payload = os.path.join(td, "fn.pkl")
+        with open(payload, "wb") as f:
+            pickle.dump((function, tuple(args)), f)
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(textwrap.dedent(_WORKER_TEMPLATE).format(payload=payload))
+        procs = []
+        for rank in range(num_processes):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)  # disable TPU-tunnel sitecustomize
+            env[env_var("MIXED_PRECISION")] = mixed_precision
+            env[env_var("COORDINATOR_ADDRESS")] = f"{addr}:{port}"
+            env[env_var("NUM_PROCESSES")] = str(num_processes)
+            env[env_var("PROCESS_ID")] = str(rank)
+            env[env_var("LOCAL_PROCESS_ID")] = str(rank)
+            env[env_var("FORK_LAUNCHED")] = "1"
+            procs.append(subprocess.Popen([sys.executable, script], env=env))
+        code = 0
+        for p in procs:
+            p.wait()
+            code = code or p.returncode
+        if code:
+            raise RuntimeError(f"notebook launcher worker failed with exit code {code}")

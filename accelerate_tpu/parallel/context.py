@@ -41,26 +41,15 @@ def _local_attn_with_lse(q, k, v, bias, sm_scale):
     is tracked as a follow-up for the extreme-context regime."""
     b, h, sq, d = q.shape
     kvh = k.shape[1]
-    if kvh != h:
-        g = h // kvh
-        qg = q.reshape(b, kvh, g, sq, d)
-        s = jnp.einsum("bkgqd,bkcd->bkgqc", qg, k, preferred_element_type=jnp.float32) * sm_scale
-        s = s + bias
-        m = jnp.max(s, axis=-1, keepdims=True)
-        p = jnp.exp(s - m)
-        l = jnp.sum(p, axis=-1, keepdims=True)
-        o = jnp.einsum("bkgqc,bkcd->bkgqd", (p / l).astype(v.dtype), v).astype(jnp.float32)
-        o = o.reshape(b, h, sq, d)
-        lse = (m + jnp.log(l)).reshape(b, h, sq)
-        return o, lse
-    s = jnp.einsum("bhqd,bhcd->bhqc", q, k, preferred_element_type=jnp.float32) * sm_scale
+    g = h // kvh  # 1 for MHA — the grouped path covers both cases
+    qg = q.reshape(b, kvh, g, sq, d)
+    s = jnp.einsum("bkgqd,bkcd->bkgqc", qg, k, preferred_element_type=jnp.float32) * sm_scale
     s = s + bias
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqc,bhcd->bhqd", (p / l).astype(v.dtype), v).astype(jnp.float32)
-    lse = (m + jnp.log(l))[..., 0]  # [B,H,Sq]
-    return o, lse
+    o = jnp.einsum("bkgqc,bkcd->bkgqd", (p / l).astype(v.dtype), v).astype(jnp.float32)
+    return o.reshape(b, h, sq, d), (m + jnp.log(l)).reshape(b, h, sq)
 
 
 def ring_attention(

@@ -58,6 +58,15 @@ def _maybe_init_jax_distributed():
     coord = get_coordinator_address()
     nproc = get_num_processes_env()
     if coord and nproc and nproc > 1:
+        # Cross-process collectives on the CPU backend need gloo (the
+        # debug/gloo-on-localhost test path, reference launchers.py:269).
+        # Setting it only configures the CPU client, so it is safe to set
+        # unconditionally — also covers hosts where CPU is the default
+        # platform without JAX_PLATFORMS being set.
+        try:
+            jax.config.update("jax_cpu_collectives", "gloo")
+        except Exception:  # pragma: no cover - older jaxlib
+            pass
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=nproc,
@@ -101,12 +110,12 @@ class PartialState:
         self.device = self.devices[0]
         backend = jax.default_backend()
         self.backend = backend
-        if backend == "cpu":
+        if self.num_processes > 1:
+            self.distributed_type = DistributedType.MULTI_HOST
+        elif backend == "cpu":
             self.distributed_type = (
                 DistributedType.CPU_SIM if jax.device_count() > 1 else DistributedType.NO
             )
-        elif self.num_processes > 1:
-            self.distributed_type = DistributedType.MULTI_HOST
         elif jax.device_count() > 1:
             self.distributed_type = DistributedType.TPU
         else:

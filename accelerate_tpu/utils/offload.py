@@ -1,0 +1,105 @@
+"""Disk-backed weight store for big-model offload.
+
+Parity target: /root/reference/src/accelerate/utils/offload.py (213 LoC) —
+numpy-memmap .dat files + index.json with dtype/shape; bfloat16 stored as a
+uint16 view (reference offload.py:32-36,57-60 uses int16; same trick). The
+TPU difference is only in who consumes it: weights stream disk -> pinned
+host -> HBM via XLA memory kinds instead of per-layer torch hooks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from typing import Optional
+
+import numpy as np
+
+_BF16 = "bfloat16"
+
+
+def offload_weight(weight, weight_name: str, offload_folder: str, index: Optional[dict] = None) -> dict:
+    """Write one array as a raw memmap file; returns the updated index."""
+    index = index if index is not None else {}
+    arr = np.asarray(weight)
+    dtype = str(arr.dtype)
+    if dtype == _BF16:
+        # numpy via ml_dtypes supports bfloat16 arrays but memmap round-trips
+        # are safer through a same-width integer view
+        arr = arr.view(np.uint16)
+    path = os.path.join(offload_folder, f"{weight_name}.dat")
+    os.makedirs(offload_folder, exist_ok=True)
+    file_array = np.memmap(path, dtype=arr.dtype, mode="w+", shape=arr.shape or (1,))
+    if arr.shape == ():
+        file_array[0] = arr
+    else:
+        file_array[:] = arr[:]
+    file_array.flush()
+    index[weight_name] = {"dtype": dtype, "shape": list(arr.shape)}
+    return index
+
+
+def load_offloaded_weight(weight_file: str, weight_info: dict) -> np.ndarray:
+    """Read one array back (memmap; zero-copy until touched)."""
+    shape = tuple(weight_info["shape"])
+    dtype = weight_info["dtype"]
+    np_dtype = np.uint16 if dtype == _BF16 else np.dtype(dtype)
+    arr = np.memmap(weight_file, dtype=np_dtype, mode="r", shape=shape or (1,))
+    if not shape:
+        arr = arr[0]
+    if dtype == _BF16:
+        import ml_dtypes
+
+        arr = arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
+def save_offload_index(index: dict, offload_folder: str) -> None:
+    with open(os.path.join(offload_folder, "index.json"), "w") as f:
+        json.dump(index, f, indent=2)
+
+
+def load_offload_index(offload_folder: str) -> dict:
+    path = os.path.join(offload_folder, "index.json")
+    if not os.path.isfile(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def offload_state_dict(save_dir: str, state_dict: Mapping) -> None:
+    """Offload a whole flat {name: array} dict (reference offload.py:66)."""
+    index = load_offload_index(save_dir)
+    for name, value in state_dict.items():
+        index = offload_weight(value, name, save_dir, index)
+    save_offload_index(index, save_dir)
+
+
+class OffloadedWeightsLoader(Mapping):
+    """Unified read-only Mapping over in-memory weights + a memmap folder
+    (reference OffloadedWeightsLoader, offload.py:127). Values load lazily."""
+
+    def __init__(self, state_dict: Optional[Mapping] = None, save_folder: Optional[str] = None):
+        if state_dict is None and save_folder is None:
+            raise ValueError("need state_dict and/or save_folder")
+        self.state_dict = dict(state_dict or {})
+        self.save_folder = save_folder
+        self.index = load_offload_index(save_folder) if save_folder else {}
+        self.all_keys = list(self.state_dict)
+        self.all_keys.extend(k for k in self.index if k not in self.all_keys)
+
+    def __getitem__(self, key: str):
+        if key in self.state_dict:
+            return self.state_dict[key]
+        if key not in self.index:
+            raise KeyError(key)
+        return load_offloaded_weight(
+            os.path.join(self.save_folder, f"{key}.dat"), self.index[key]
+        )
+
+    def __iter__(self):
+        return iter(self.all_keys)
+
+    def __len__(self):
+        return len(self.all_keys)

@@ -1,0 +1,180 @@
+"""Big-model inference path: abstract init, auto device maps, offload
+round-trips, dispatched forward (reference tests/test_big_modeling.py +
+test_modeling_utils.py + test_offload.py shapes)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.big_modeling import (
+    DispatchedModel,
+    cpu_offload,
+    disk_offload,
+    dispatch_model,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+)
+from accelerate_tpu.models import DecoderConfig, DecoderLM
+from accelerate_tpu.utils.modeling import (
+    compute_module_sizes,
+    dtype_byte_size,
+    find_tied_parameters,
+    get_max_memory,
+    infer_auto_device_map,
+    load_checkpoint_in_model,
+    placement_of,
+)
+from accelerate_tpu.utils.offload import (
+    OffloadedWeightsLoader,
+    load_offloaded_weight,
+    offload_state_dict,
+    offload_weight,
+    save_offload_index,
+)
+
+
+def _tiny_model():
+    cfg = DecoderConfig.tiny()
+    model = DecoderLM(cfg)
+    return model, cfg
+
+
+class TestOffloadStore:
+    @pytest.mark.parametrize("dtype", ["float32", "int32", "bfloat16"])
+    def test_weight_roundtrip(self, tmp_path, dtype):
+        import ml_dtypes
+
+        np_dtype = ml_dtypes.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)
+        w = np.arange(12, dtype=np.float64).reshape(3, 4).astype(np_dtype)
+        index = offload_weight(w, "w", str(tmp_path))
+        save_offload_index(index, str(tmp_path))
+        back = load_offloaded_weight(str(tmp_path / "w.dat"), index["w"])
+        np.testing.assert_array_equal(np.asarray(back, np.float32), np.asarray(w, np.float32))
+
+    def test_scalar_roundtrip(self, tmp_path):
+        index = offload_weight(np.float32(3.5), "s", str(tmp_path))
+        back = load_offloaded_weight(str(tmp_path / "s.dat"), index["s"])
+        assert float(back) == 3.5
+
+    def test_weights_loader_merges_sources(self, tmp_path):
+        offload_state_dict(str(tmp_path), {"disk_w": np.ones((2, 2))})
+        loader = OffloadedWeightsLoader(state_dict={"mem_w": np.zeros(3)}, save_folder=str(tmp_path))
+        assert set(loader) == {"mem_w", "disk_w"}
+        np.testing.assert_array_equal(loader["disk_w"], np.ones((2, 2)))
+
+
+class TestModelingUtils:
+    def test_dtype_byte_size(self):
+        assert dtype_byte_size(jnp.float32) == 4
+        assert dtype_byte_size(jnp.bfloat16) == 2
+        assert dtype_byte_size(jnp.int8) == 1
+
+    def test_abstract_init_allocates_nothing(self):
+        model, cfg = _tiny_model()
+        abstract = init_empty_weights(model, jnp.zeros((1, 8), jnp.int32))
+        leaves = jax.tree_util.tree_leaves(abstract)
+        assert all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+        assert cfg.num_params == sum(int(np.prod(l.shape)) for l in leaves)
+
+    def test_compute_module_sizes_totals(self):
+        model, cfg = _tiny_model()
+        abstract = init_empty_weights(model, jnp.zeros((1, 8), jnp.int32))
+        sizes = compute_module_sizes(abstract["params"])
+        assert sizes[""] == cfg.num_params * 4  # f32
+        assert sizes["embedding"] == cfg.vocab_size * cfg.embed_dim * 4
+
+    def test_find_tied_parameters(self):
+        w = np.ones((2, 2))
+        tree = {"a": {"emb": w}, "b": {"head": w}, "c": np.zeros(3)}
+        ties = find_tied_parameters(tree)
+        assert ties == [["a/emb", "b/head"]]
+
+    def test_infer_auto_device_map_spills_in_order(self):
+        model, _ = _tiny_model()
+        abstract = init_empty_weights(model, jnp.zeros((1, 8), jnp.int32))
+        params = abstract["params"]
+        sizes = compute_module_sizes(params)
+        # budget fits only part on "device" -> rest spills to cpu then disk
+        budget = {"device": sizes[""] // 2, "cpu": sizes[""] // 3, "disk": 1 << 62}
+        dm = infer_auto_device_map(params, max_memory=budget, reserve_largest=False)
+        tiers = set(dm.values())
+        assert "device" in tiers and ("cpu" in tiers or "disk" in tiers)
+        # everything on device when budget is huge
+        dm_all = infer_auto_device_map(params, max_memory={"device": 1 << 62}, reserve_largest=False)
+        assert set(dm_all.values()) == {"device"}
+
+    def test_get_max_memory_has_tiers(self):
+        mm = get_max_memory()
+        assert mm["device"] > 0 and mm["cpu"] > 0 and mm["disk"] > mm["cpu"]
+
+    def test_placement_longest_prefix_wins(self):
+        dm = {"": "device", "layers": "cpu", "layers/block/attn": "disk"}
+        assert placement_of("embedding", dm) == "device"
+        assert placement_of("layers/block/mlp/w_up", dm) == "cpu"
+        assert placement_of("layers/block/attn/wq", dm) == "disk"
+
+
+class TestDispatch:
+    def _params_and_batch(self, model, cfg):
+        variables = model.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+        from accelerate_tpu.parallel.sharding import unbox_params
+
+        params, _ = unbox_params(variables["params"])
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (1, 16)))
+        ref = model.apply({"params": params}, ids)["logits"]
+        return params, ids, ref
+
+    def test_cpu_offload_matches_dense(self):
+        model, cfg = _tiny_model()
+        params, ids, ref = self._params_and_batch(model, cfg)
+        dispatched = cpu_offload(model, params)
+        out = dispatched(ids)["logits"]
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_disk_offload_matches_dense(self, tmp_path):
+        model, cfg = _tiny_model()
+        params, ids, ref = self._params_and_batch(model, cfg)
+        dispatched = disk_offload(model, params, str(tmp_path))
+        out = dispatched(ids)["logits"]
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+        assert os.path.exists(tmp_path / "index.json")
+
+    def test_mixed_dispatch_matches_dense(self, tmp_path):
+        model, cfg = _tiny_model()
+        params, ids, ref = self._params_and_batch(model, cfg)
+        dm = {"": "device", "layers": "cpu", "embedding": "disk"}
+        dispatched = dispatch_model(model, params, dm, offload_folder=str(tmp_path))
+        out = dispatched(ids)["logits"]
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_materialize_promotes_everything(self, tmp_path):
+        model, cfg = _tiny_model()
+        params, ids, ref = self._params_and_batch(model, cfg)
+        dispatched = disk_offload(model, params, str(tmp_path)).materialize()
+        leaves = jax.tree_util.tree_leaves(dispatched.params)
+        assert all(isinstance(l, jax.Array) for l in leaves)
+
+    def test_load_checkpoint_and_dispatch_roundtrip(self, tmp_path):
+        from accelerate_tpu.utils.serialization import save_pytree
+
+        model, cfg = _tiny_model()
+        params, ids, ref = self._params_and_batch(model, cfg)
+        ckpt = tmp_path / "model.safetensors"
+        save_pytree(params, str(ckpt))
+        dispatched = load_checkpoint_and_dispatch(
+            model, str(ckpt), jnp.zeros((1, 8), jnp.int32), device_map="auto"
+        )
+        out = dispatched(ids)["logits"]
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_load_checkpoint_in_model_missing_weight_errors(self, tmp_path):
+        from accelerate_tpu.utils.serialization import save_pytree
+
+        model, cfg = _tiny_model()
+        abstract = init_empty_weights(model, jnp.zeros((1, 8), jnp.int32))["params"]
+        save_pytree({"embedding": np.zeros((4, 4))}, str(tmp_path / "partial.safetensors"))
+        with pytest.raises(ValueError, match="missing"):
+            load_checkpoint_in_model(abstract, str(tmp_path / "partial.safetensors"))

@@ -1,0 +1,115 @@
+"""CLI tests: run the real `accelerate-tpu` commands as subprocesses
+(reference tests/test_cli.py, 519 LoC — same strategy: subprocess + config
+yaml round-trips; the launch tests use --cpu multi-process, which is the
+gloo-on-localhost analog)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+CLI = [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli"]
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, env_extra=None, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    return subprocess.run(
+        CLI + args, capture_output=True, text=True, env=env, timeout=timeout, cwd=REPO
+    )
+
+
+class TestEnvCommand:
+    def test_env_prints_platform(self):
+        r = _run(["env"])
+        assert r.returncode == 0, r.stderr
+        assert "accelerate_tpu" in r.stdout
+
+
+class TestConfigCommand:
+    def test_default_writes_yaml_and_roundtrips(self, tmp_path):
+        r = _run(["config", "default"], env_extra={"ACCELERATE_TPU_CONFIG_HOME": str(tmp_path)})
+        assert r.returncode == 0, r.stderr
+        path = tmp_path / "default_config.yaml"
+        assert path.exists()
+        from accelerate_tpu.commands.config_args import ClusterConfig
+
+        cfg = ClusterConfig.from_yaml_file(path)
+        assert cfg.compute_environment == "LOCAL_MACHINE"
+
+    def test_unknown_keys_ignored(self, tmp_path):
+        p = tmp_path / "c.yaml"
+        p.write_text("mixed_precision: bf16\nbogus_key: 1\n")
+        from accelerate_tpu.commands.config_args import ClusterConfig
+
+        cfg = ClusterConfig.from_yaml_file(p)
+        assert cfg.mixed_precision == "bf16"
+
+
+class TestEstimateCommand:
+    def test_preset_json(self):
+        r = _run(["estimate", "decoder:tiny", "--json"])
+        assert r.returncode == 0, r.stderr
+        data = json.loads(r.stdout.strip().splitlines()[-1])
+        from accelerate_tpu.models import DecoderConfig
+
+        assert data["rows"][0]["params"] == DecoderConfig.tiny().num_params
+
+    def test_param_count_spec(self):
+        r = _run(["estimate", "350M", "--dtypes", "bfloat16", "--json"])
+        assert r.returncode == 0, r.stderr
+        data = json.loads(r.stdout.strip().splitlines()[-1])
+        assert data["rows"][0]["inference_total"] == 700_000_000
+
+
+class TestMergeCommand:
+    def test_merge_roundtrip(self, tmp_path):
+        from accelerate_tpu.utils.serialization import load_flat_dict, save_pytree
+
+        src = {"a/w": np.ones((4, 4), np.float32), "b/w": np.zeros((2,), np.float32)}
+        save_pytree(src, str(tmp_path / "model.safetensors"))
+        out = tmp_path / "merged.safetensors"
+        r = _run(["merge-weights", str(tmp_path / "model.safetensors"), str(out)])
+        assert r.returncode == 0, r.stderr
+        merged = load_flat_dict(str(out))
+        assert set(merged) == set(src)
+        np.testing.assert_array_equal(merged["a/w"], src["a/w"])
+
+
+class TestLaunch:
+    def test_single_process_launch_runs_script(self, tmp_path):
+        script = tmp_path / "s.py"
+        script.write_text(
+            "import os\n"
+            "assert os.environ['ACCELERATE_TPU_MIXED_PRECISION'] == 'bf16'\n"
+            "assert os.environ['ACCELERATE_TPU_FSDP'] == '4'\n"
+            "print('LAUNCHED OK')\n"
+        )
+        r = _run(["launch", "--cpu", "--mixed_precision", "bf16", "--fsdp", "4", str(script)])
+        assert r.returncode == 0, r.stderr
+        assert "LAUNCHED OK" in r.stdout
+
+    def test_launch_propagates_failure(self, tmp_path):
+        script = tmp_path / "fail.py"
+        script.write_text("raise SystemExit(3)\n")
+        r = _run(["launch", "--cpu", str(script)])
+        assert r.returncode == 3
+
+    @pytest.mark.slow
+    def test_bundled_test_two_processes(self):
+        r = _run(["test", "--cpu", "--num_processes", "2"])
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "Test is a success" in r.stdout
+
+
+class TestNotebookLauncher:
+    def test_single_process_inline(self):
+        from accelerate_tpu import notebook_launcher
+
+        out = notebook_launcher(lambda a, b: a + b, (1, 2))
+        assert out == 3

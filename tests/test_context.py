@@ -95,7 +95,6 @@ class TestContextParallelTraining:
     def test_sequence_parallel_matches_dense_forward(self):
         """The same params give the same loss with and without the ring."""
         from accelerate_tpu.models import DecoderConfig, DecoderLM
-        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
         cfg = DecoderConfig.tiny()
         ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 32))
