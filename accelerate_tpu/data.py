@@ -359,9 +359,11 @@ class DataLoaderShard(BaseDataLoader):
         skip_batches: int = 0,
         _drop_last: bool = False,
         batch_axes: tuple = ("replica", "data", "fsdp"),
+        prefetch_depth: int = 0,
     ):
         super().__init__()
         self.base_loader = base_loader
+        self.prefetch_depth = prefetch_depth
         self.mesh = mesh
         self.rng_types = rng_types or []
         self.batch_size = batch_size
@@ -428,8 +430,16 @@ class DataLoaderShard(BaseDataLoader):
             if rem != 0:
                 self.remainder = rem
         per_proc = self.batch_size
+        prefetcher = None
         try:
             iterator = iter(self.base_loader)
+            if self.prefetch_depth > 1:
+                # native host prefetch ring: batch assembly overlaps device
+                # compute (runtime/prefetch.py); dict-of-array batches only
+                from .runtime.prefetch import HostPrefetcher
+
+                prefetcher = HostPrefetcher(iterator, depth=self.prefetch_depth)
+                iterator = iter(prefetcher)
             # one-batch-ahead prefetch to flag end_of_dataloader on the LAST
             # yield (reference :555-578)
             try:
@@ -459,6 +469,10 @@ class DataLoaderShard(BaseDataLoader):
                 current = upcoming
                 batch_index += 1
         finally:
+            if prefetcher is not None:
+                # unblock + drop the producer thread even when the consumer
+                # abandons the epoch early (max_steps / early stop)
+                prefetcher.close()
             self.iteration += 1
             self.end()
 
@@ -645,6 +659,7 @@ def prepare_data_loader(
         batch_size=per_proc_bs,
         even_batches=even_batches,
         device_put=put_on_device,
+        prefetch_depth=config.prefetch_depth if config is not None else 0,
     )
 
 

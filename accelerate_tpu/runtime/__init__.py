@@ -1,0 +1,13 @@
+"""Native host runtime: C++ primitives (csrc/att_runtime.cpp) behind
+graceful Python fallbacks. See native.py for the build/load protocol."""
+
+from .native import native_available, parallel_memcpy, parallel_read_segments
+from .prefetch import HostPrefetcher, RingBuffer
+
+__all__ = [
+    "native_available",
+    "parallel_memcpy",
+    "parallel_read_segments",
+    "HostPrefetcher",
+    "RingBuffer",
+]

@@ -1,0 +1,150 @@
+"""ctypes bindings for csrc/att_runtime.cpp, with build-on-first-use.
+
+Why ctypes and not an extension module: the C library has a pure C ABI
+(no Python.h), so one `g++ -O3 -shared -fPIC -pthread` works on any image
+with a toolchain and nothing to compile against; ctypes FFI calls release
+the GIL, which is the entire point (parallel IO / memcpy while Python
+drives the train loop). Every entry point has a numpy fallback so the
+framework works unbuilt.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc", "att_runtime.cpp")
+_OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+_OUT = os.path.join(_OUT_DIR, "libatt_runtime.so")
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_OUT) and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC):
+        return _OUT
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", _OUT]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _OUT
+    except Exception as e:  # pragma: no cover - no toolchain
+        logger.warning(f"att_runtime native build failed ({e}); using Python fallbacks")
+        return None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    if _LIB is not None or _LIB_TRIED:
+        return _LIB
+    with _LIB_LOCK:
+        if _LIB is not None or _LIB_TRIED:
+            return _LIB
+        _LIB_TRIED = True
+        if os.environ.get("ACCELERATE_TPU_DISABLE_NATIVE"):
+            return None
+        path = _build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        ucpp = ctypes.POINTER(ctypes.c_void_p)
+        lib.att_parallel_read.argtypes = [ctypes.c_char_p, u64p, u64p, ucpp, ctypes.c_int, ctypes.c_int]
+        lib.att_parallel_read.restype = ctypes.c_int
+        lib.att_parallel_memcpy.argtypes = [ucpp, ucpp, u64p, ctypes.c_int, ctypes.c_int]
+        lib.att_parallel_memcpy.restype = None
+        lib.att_ring_create.argtypes = [ctypes.c_int, ctypes.c_uint64]
+        lib.att_ring_create.restype = ctypes.c_void_p
+        for name, argtypes, restype in [
+            ("att_ring_destroy", [ctypes.c_void_p], None),
+            ("att_ring_close", [ctypes.c_void_p], None),
+            ("att_ring_acquire_fill", [ctypes.c_void_p], ctypes.c_int),
+            ("att_ring_commit_fill", [ctypes.c_void_p, ctypes.c_int], None),
+            ("att_ring_acquire_read", [ctypes.c_void_p], ctypes.c_int),
+            ("att_ring_release_read", [ctypes.c_void_p, ctypes.c_int], None),
+            ("att_ring_slot_ptr", [ctypes.c_void_p, ctypes.c_int], ctypes.c_void_p),
+            ("att_ring_slot_bytes", [ctypes.c_void_p], ctypes.c_uint64),
+        ]:
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = restype
+        _LIB = lib
+        return _LIB
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+def _as_u64_array(values: Sequence[int]):
+    return (ctypes.c_uint64 * len(values))(*values)
+
+
+def _as_ptr_array(buffers) -> "ctypes.Array":
+    arr = (ctypes.c_void_p * len(buffers))()
+    for i, b in enumerate(buffers):
+        arr[i] = b.ctypes.data if isinstance(b, np.ndarray) else ctypes.cast(b, ctypes.c_void_p)
+    return arr
+
+
+def parallel_read_segments(
+    path: str,
+    offsets: Sequence[int],
+    dests: Sequence[np.ndarray],
+    num_threads: int = 8,
+) -> None:
+    """Read len(offsets) byte segments of ``path`` into the (1-D uint8 or
+    contiguous) ``dests`` arrays; segment i has size dests[i].nbytes."""
+    sizes = [int(d.nbytes) for d in dests]
+    lib = _get_lib()
+    if lib is None:
+        with open(path, "rb") as f:
+            for off, dst in zip(offsets, dests):
+                f.seek(off)
+                buf = f.read(dst.nbytes)
+                flat = dst.reshape(-1).view(np.uint8)
+                flat[:] = np.frombuffer(buf, np.uint8)
+        return
+    rc = lib.att_parallel_read(
+        path.encode(),
+        _as_u64_array(list(offsets)),
+        _as_u64_array(sizes),
+        ctypes.cast(_as_ptr_array(list(dests)), ctypes.POINTER(ctypes.c_void_p)),
+        len(dests),
+        num_threads,
+    )
+    if rc != 0:
+        raise OSError(f"att_parallel_read({path}) failed with code {rc}")
+
+
+def parallel_memcpy(dests: Sequence[np.ndarray], srcs: Sequence[np.ndarray], num_threads: int = 8) -> None:
+    """Copy srcs[i] -> dests[i] (same nbytes) on native threads."""
+    assert len(dests) == len(srcs)
+    sizes = []
+    for d, s in zip(dests, srcs):
+        if d.nbytes != s.nbytes:
+            raise ValueError(f"size mismatch {d.nbytes} != {s.nbytes}")
+        sizes.append(int(d.nbytes))
+    lib = _get_lib()
+    if lib is None:
+        for d, s in zip(dests, srcs):
+            np.copyto(d.reshape(-1).view(np.uint8), np.ascontiguousarray(s).reshape(-1).view(np.uint8))
+        return
+    srcs = [np.ascontiguousarray(s) for s in srcs]
+    lib.att_parallel_memcpy(
+        ctypes.cast(_as_ptr_array(list(dests)), ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.cast(_as_ptr_array(srcs), ctypes.POINTER(ctypes.c_void_p)),
+        _as_u64_array(sizes),
+        len(dests),
+        num_threads,
+    )

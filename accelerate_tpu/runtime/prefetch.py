@@ -1,0 +1,235 @@
+"""Host-side prefetch: assemble the next batch while the device computes.
+
+`RingBuffer` wraps the native slots/condvar ring (csrc) with a pure-Python
+fallback; `HostPrefetcher` runs a producer thread that pulls from any
+iterator, assembles each batch into a ring slot with GIL-free parallel
+memcpy, and (optionally) starts the host->device transfer so the train
+loop's `next()` returns an already-in-flight batch.
+
+This replaces the torch DataLoader's worker-process machinery (reference
+data_loader.py leans on torch's C++ loader): JAX needs the batch as one
+contiguous host buffer per step, which is exactly what the ring provides.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from .native import _get_lib, parallel_memcpy
+
+
+class RingBuffer:
+    """Fixed-size slot ring (producer/consumer). Native-backed when built."""
+
+    def __init__(self, slots: int, slot_bytes: int):
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        self._lib = _get_lib()
+        if self._lib is not None:
+            self._ring = self._lib.att_ring_create(slots, slot_bytes)
+            self._buffers = None
+        else:
+            self._ring = None
+            self._buffers = [np.empty(slot_bytes, np.uint8) for _ in range(slots)]
+            self._state = [0] * slots  # 0 free, 2 ready
+            self._fill_cursor = 0
+            self._read_cursor = 0
+            self._closed = False
+            self._cond = threading.Condition()
+
+    # -- producer ---------------------------------------------------------
+    def acquire_fill(self) -> int:
+        if self._ring is not None:
+            return self._lib.att_ring_acquire_fill(self._ring)
+        with self._cond:
+            slot = self._fill_cursor
+            self._cond.wait_for(lambda: self._closed or self._state[slot] == 0)
+            if self._closed:
+                return -1
+            self._state[slot] = 1
+            self._fill_cursor = (slot + 1) % self.slots
+            return slot
+
+    def commit_fill(self, slot: int) -> None:
+        if self._ring is not None:
+            self._lib.att_ring_commit_fill(self._ring, slot)
+            return
+        with self._cond:
+            self._state[slot] = 2
+            self._cond.notify_all()
+
+    # -- consumer ---------------------------------------------------------
+    def acquire_read(self) -> int:
+        if self._ring is not None:
+            return self._lib.att_ring_acquire_read(self._ring)
+        with self._cond:
+            slot = self._read_cursor
+            self._cond.wait_for(lambda: self._closed or self._state[slot] == 2)
+            if self._state[slot] != 2:
+                return -1
+            self._state[slot] = 3
+            self._read_cursor = (slot + 1) % self.slots
+            return slot
+
+    def release_read(self, slot: int) -> None:
+        if self._ring is not None:
+            self._lib.att_ring_release_read(self._ring, slot)
+            return
+        with self._cond:
+            self._state[slot] = 0
+            self._cond.notify_all()
+
+    def slot_view(self, slot: int) -> np.ndarray:
+        """uint8 view of a slot's storage (zero-copy)."""
+        if self._ring is not None:
+            ptr = self._lib.att_ring_slot_ptr(self._ring, slot)
+            return np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)), shape=(self.slot_bytes,)
+            )
+        return self._buffers[slot]
+
+    def close(self) -> None:
+        if self._ring is not None:
+            self._lib.att_ring_close(self._ring)
+            return
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def __del__(self):
+        try:
+            if getattr(self, "_ring", None) is not None:
+                self._lib.att_ring_destroy(self._ring)
+                self._ring = None
+        except Exception:
+            pass
+
+
+class HostPrefetcher:
+    """Iterator wrapper: a producer thread keeps ``depth`` assembled batches
+    ahead of the consumer.
+
+    Each source item must be a dict of numpy arrays with fixed shapes
+    (static-shape contract of the jit step). ``transform`` (e.g.
+    make_global_batch for device placement) runs on the consumer side.
+    """
+
+    def __init__(
+        self,
+        source: Iterator,
+        depth: int = 2,
+        transform: Optional[Callable] = None,
+        copy_threads: int = 4,
+    ):
+        self.source = iter(source)
+        self.depth = max(2, depth)
+        self.transform = transform
+        self.copy_threads = copy_threads
+        self._ring: Optional[RingBuffer] = None
+        self._layout = None  # [(key, shape, dtype, byte_offset, nbytes)]
+        self._slot_bytes = 0
+        self._out: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._done = threading.Event()
+
+    def _init_layout(self, first) -> None:
+        offset = 0
+        layout = []
+        if isinstance(first, dict):
+            for key in sorted(first):
+                arr = np.asarray(first[key])
+                if arr.dtype == object:
+                    layout = []
+                    offset = 0
+                    break
+                layout.append((key, arr.shape, arr.dtype, offset, arr.nbytes))
+                offset += (arr.nbytes + 63) // 64 * 64  # 64B-align each field
+        self._layout = layout
+        self._slot_bytes = max(offset, 64)
+        self._ring = RingBuffer(self.depth, self._slot_bytes)
+        # side-channel for batches that don't match the layout (e.g. the
+        # ragged final batch): carried as objects, ring slot left untouched
+        self._slot_objects = [None] * self.depth
+
+    def _matches_layout(self, batch) -> bool:
+        if not self._layout or not isinstance(batch, dict):
+            return False
+        if set(batch) != {k for k, *_ in self._layout}:
+            return False
+        return all(
+            batch[key].shape == shape and batch[key].dtype == dtype
+            for key, shape, dtype, _, _ in self._layout
+        )
+
+    def _fill(self, slot: int, batch) -> None:
+        if not self._matches_layout(batch):
+            self._slot_objects[slot] = batch
+            return
+        self._slot_objects[slot] = None
+        view = self._ring.slot_view(slot)
+        dsts, srcs = [], []
+        for key, shape, dtype, off, nbytes in self._layout:
+            dsts.append(view[off : off + nbytes].view(dtype).reshape(shape))
+            srcs.append(np.ascontiguousarray(batch[key], dtype=dtype))
+        parallel_memcpy(dsts, srcs, num_threads=self.copy_threads)
+
+    def _producer(self) -> None:
+        try:
+            for batch in self.source:
+                if isinstance(batch, dict):
+                    batch = {k: np.asarray(v) for k, v in batch.items()}
+                if self._layout is None:
+                    self._init_layout(batch)
+                    self._started.set()
+                slot = self._ring.acquire_fill()
+                if slot < 0:
+                    return
+                self._fill(slot, batch)
+                self._ring.commit_fill(slot)
+            self._done.set()
+            if self._ring is not None:
+                self._ring.close()
+            self._started.set()
+        except Exception as e:  # propagate to consumer
+            self._error = e
+            self._done.set()
+            self._started.set()
+            if self._ring is not None:
+                self._ring.close()
+
+    def __iter__(self):
+        self._error = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+        self._started.wait()
+        while True:
+            if self._ring is None:  # empty source
+                break
+            slot = self._ring.acquire_read()
+            if slot < 0:
+                break
+            if self._slot_objects[slot] is not None:
+                batch = self._slot_objects[slot]
+                self._slot_objects[slot] = None
+            else:
+                view = self._ring.slot_view(slot)
+                batch = {}
+                for key, shape, dtype, off, nbytes in self._layout:
+                    # copy out so the slot can be reused immediately; still
+                    # cheaper than Python-side stacking because the producer
+                    # did the assembly off-thread
+                    batch[key] = view[off : off + nbytes].view(dtype).reshape(shape).copy()
+            self._ring.release_read(slot)
+            yield self.transform(batch) if self.transform else batch
+        if self._error is not None:
+            raise self._error
+
+    def close(self):
+        if self._ring is not None:
+            self._ring.close()

@@ -232,6 +232,10 @@ class DataLoaderConfiguration:
     non_blocking: bool = False
     use_stateful_dataloader: bool = False
     data_sharding_axes: Optional[tuple] = None  # mesh axes the batch dim is sharded over
+    # >1 enables the native host prefetch ring (runtime/prefetch.py): a
+    # producer thread assembles this many batches ahead with GIL-free
+    # parallel memcpy while the device computes
+    prefetch_depth: int = 0
 
 
 @dataclass

@@ -136,13 +136,56 @@ def load_flat_dict(path: str | os.PathLike) -> dict[str, np.ndarray]:
             out.update(load_flat_dict(os.path.join(folder, fname)))
         return out
     if path.endswith(".safetensors") or _is_safetensors(path):
-        from safetensors.numpy import load_file
-
-        return load_file(path)
+        return _load_safetensors(path)
     import pickle
 
     with open(path, "rb") as f:
         return pickle.load(f)
+
+
+_SAFETENSORS_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U64": np.uint64, "U32": np.uint32, "U16": np.uint16, "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+def _load_safetensors(path: str) -> dict[str, np.ndarray]:
+    """Safetensors load via the native parallel reader (csrc/att_runtime):
+    the header is parsed in Python, then every tensor's byte segment is
+    pread on C++ threads straight into its destination array — checkpoint
+    load time is a headline metric (reference big_model_inference loads run
+    8.7-112s on the published table). Falls back to safetensors.numpy."""
+    from ..runtime.native import native_available, parallel_read_segments
+
+    try:
+        available = native_available()
+    except Exception:
+        available = False
+    if not available:
+        from safetensors.numpy import load_file
+
+        return load_file(path)
+    with open(path, "rb") as f:
+        header_len = int.from_bytes(f.read(8), "little")
+        header = json.loads(f.read(header_len))
+    data_start = 8 + header_len
+    names, offsets, dests = [], [], []
+    import ml_dtypes
+
+    for name, info in header.items():
+        if name == "__metadata__":
+            continue
+        dt = info["dtype"]
+        np_dtype = ml_dtypes.bfloat16 if dt == "BF16" else _SAFETENSORS_DTYPES[dt]
+        arr = np.empty(tuple(info["shape"]), dtype=np_dtype)
+        names.append(name)
+        offsets.append(data_start + info["data_offsets"][0])
+        dests.append(arr)
+    if dests:
+        parallel_read_segments(path, offsets, dests)
+    return dict(zip(names, dests))
 
 
 def _is_safetensors(path: str) -> bool:

@@ -1,0 +1,205 @@
+// att_runtime — native host-runtime primitives for accelerate_tpu.
+//
+// The reference framework has no native code of its own (SURVEY preamble:
+// every native capability comes from torch/NCCL/torch_xla). In a JAX
+// framework the device path is XLA; what remains host-side and
+// performance-critical is IO and batch assembly, both GIL-bound in pure
+// Python:
+//
+//   * att_parallel_read  — multithreaded pread of tensor segments from a
+//     checkpoint file straight into destination buffers (drives
+//     serialization.load_flat_dict; checkpoint-load latency is a headline
+//     benchmark: reference big_model_inference loads are 8.7-112s).
+//   * att_parallel_memcpy — multithreaded scatter/gather copy used by the
+//     prefetcher to assemble per-host batch buffers while the previous
+//     step runs on device (ctypes releases the GIL around the call).
+//   * att_ring_* — a slots/condvar ring buffer giving the double-buffered
+//     producer/consumer contract (pallas_guide.md double-buffering pattern,
+//     applied host-side).
+//
+// Pure C ABI on purpose: loaded via ctypes, no Python.h / pybind11
+// dependency, trivially built with `g++ -O3 -shared -fPIC -pthread`.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Segment {
+  uint64_t file_offset;
+  uint64_t size;
+  unsigned char *dst;
+};
+
+// Split [0, count) into contiguous chunks and run fn(chunk_begin, chunk_end)
+// on num_threads workers.
+void parallel_for(int count, int num_threads, void (*body)(int, void *), void *ctx) {
+  if (num_threads < 1) num_threads = 1;
+  if (num_threads > count) num_threads = count > 0 ? count : 1;
+  std::atomic<int> next{0};
+  std::vector<std::thread> workers;
+  workers.reserve(num_threads);
+  for (int t = 0; t < num_threads; ++t) {
+    workers.emplace_back([&]() {
+      int i;
+      while ((i = next.fetch_add(1)) < count) body(i, ctx);
+    });
+  }
+  for (auto &w : workers) w.join();
+}
+
+} // namespace
+
+extern "C" {
+
+// Read `count` segments of `path` into caller-provided buffers.
+// Returns 0 on success, -errno-style negative on failure.
+int att_parallel_read(const char *path, const uint64_t *file_offsets,
+                      const uint64_t *sizes, unsigned char **dsts, int count,
+                      int num_threads) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  std::atomic<int> err{0};
+  struct Ctx {
+    int fd;
+    const uint64_t *off;
+    const uint64_t *sz;
+    unsigned char **dst;
+    std::atomic<int> *err;
+  } ctx{fd, file_offsets, sizes, dsts, &err};
+  parallel_for(
+      count, num_threads,
+      [](int i, void *p) {
+        auto *c = static_cast<Ctx *>(p);
+        uint64_t remaining = c->sz[i];
+        uint64_t off = c->off[i];
+        unsigned char *dst = c->dst[i];
+        while (remaining > 0) {
+          ssize_t got = ::pread(c->fd, dst, remaining, (off_t)off);
+          if (got <= 0) {
+            c->err->store(-2);
+            return;
+          }
+          remaining -= (uint64_t)got;
+          off += (uint64_t)got;
+          dst += got;
+        }
+      },
+      &ctx);
+  ::close(fd);
+  return err.load();
+}
+
+void att_parallel_memcpy(unsigned char **dsts, const unsigned char **srcs,
+                         const uint64_t *sizes, int count, int num_threads) {
+  struct Ctx {
+    unsigned char **dst;
+    const unsigned char **src;
+    const uint64_t *sz;
+  } ctx{dsts, srcs, sizes};
+  parallel_for(
+      count, num_threads,
+      [](int i, void *p) {
+        auto *c = static_cast<Ctx *>(p);
+        std::memcpy(c->dst[i], c->src[i], c->sz[i]);
+      },
+      &ctx);
+}
+
+// ---------------------------------------------------------------------------
+// Ring buffer: fixed slot count, each slot a contiguous byte buffer.
+// Producer: acquire_fill -> write via slot_ptr -> commit_fill.
+// Consumer: acquire_read -> read -> release_read.
+// ---------------------------------------------------------------------------
+
+struct Ring {
+  int slots;
+  uint64_t slot_bytes;
+  std::vector<std::vector<unsigned char>> storage;
+  std::vector<int> state; // 0=free, 1=filling, 2=ready, 3=reading
+  int fill_cursor = 0;
+  int read_cursor = 0;
+  bool closed = false;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+void *att_ring_create(int slots, uint64_t slot_bytes) {
+  auto *r = new Ring();
+  r->slots = slots;
+  r->slot_bytes = slot_bytes;
+  r->storage.resize(slots);
+  for (auto &s : r->storage) s.resize(slot_bytes);
+  r->state.assign(slots, 0);
+  return r;
+}
+
+void att_ring_destroy(void *ring) { delete static_cast<Ring *>(ring); }
+
+void att_ring_close(void *ring) {
+  auto *r = static_cast<Ring *>(ring);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+  }
+  r->cv.notify_all();
+}
+
+// Returns slot index, or -1 if the ring is closed.
+int att_ring_acquire_fill(void *ring) {
+  auto *r = static_cast<Ring *>(ring);
+  std::unique_lock<std::mutex> lk(r->mu);
+  int slot = r->fill_cursor;
+  r->cv.wait(lk, [&] { return r->closed || r->state[slot] == 0; });
+  if (r->closed) return -1;
+  r->state[slot] = 1;
+  r->fill_cursor = (slot + 1) % r->slots;
+  return slot;
+}
+
+void att_ring_commit_fill(void *ring, int slot) {
+  auto *r = static_cast<Ring *>(ring);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->state[slot] = 2;
+  }
+  r->cv.notify_all();
+}
+
+int att_ring_acquire_read(void *ring) {
+  auto *r = static_cast<Ring *>(ring);
+  std::unique_lock<std::mutex> lk(r->mu);
+  int slot = r->read_cursor;
+  r->cv.wait(lk, [&] { return r->closed || r->state[slot] == 2; });
+  if (r->state[slot] != 2) return -1; // closed and nothing ready
+  r->state[slot] = 3;
+  r->read_cursor = (slot + 1) % r->slots;
+  return slot;
+}
+
+void att_ring_release_read(void *ring, int slot) {
+  auto *r = static_cast<Ring *>(ring);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->state[slot] = 0;
+  }
+  r->cv.notify_all();
+}
+
+unsigned char *att_ring_slot_ptr(void *ring, int slot) {
+  auto *r = static_cast<Ring *>(ring);
+  return r->storage[slot].data();
+}
+
+uint64_t att_ring_slot_bytes(void *ring) {
+  return static_cast<Ring *>(ring)->slot_bytes;
+}
+
+} // extern "C"
