@@ -133,72 +133,77 @@ class BatchSamplerShard:
         return self._iter_with_split() if self.split_batches else self._iter_with_no_split()
 
     def _iter_with_split(self):
-        # Semantics of reference :187-208: yield this process's slice of each
-        # FULL global batch; on a ragged tail, either yield the partial slice
-        # (even_batches=False) or complete the tail by cycling from the start.
-        initial_data: list = []
-        batch = []
-        chunk = self.batch_size // self.num_processes
-        for idx, batch in enumerate(self.batch_sampler):
-            batch = list(batch)
-            if idx == 0:
-                initial_data = batch
+        # Each FULL global batch contributes this process's contiguous window
+        # [lo:hi]. A ragged final batch is either sliced as-is
+        # (even_batches=False) or squared up by cycling samples from the
+        # stream's head before slicing. Capability parity with reference
+        # data_loader.py:187-208; written against the window formulation.
+        per_proc = self.batch_size // self.num_processes
+        lo, hi = per_proc * self.process_index, per_proc * (self.process_index + 1)
+        head: list = []  # first batch seen, the wraparound source
+        tail: list = []  # the stream's ragged final batch, if any
+        for raw in self.batch_sampler:
+            batch = list(raw)
+            if not head:
+                head = batch
             if len(batch) == self.batch_size:
-                yield batch[chunk * self.process_index : chunk * (self.process_index + 1)]
-        if not self.drop_last and len(initial_data) > 0 and len(batch) < self.batch_size:
-            if not self.even_batches:
-                if len(batch) > chunk * self.process_index:
-                    yield batch[chunk * self.process_index : chunk * (self.process_index + 1)]
+                yield batch[lo:hi]
+                tail = []  # a short batch only counts if it ends the stream
             else:
-                while len(initial_data) < self.batch_size:
-                    initial_data += initial_data
-                batch = batch + initial_data
-                yield batch[chunk * self.process_index : chunk * (self.process_index + 1)]
-
-    def _iter_with_no_split(self):
-        # Semantics of reference :209-253: round-robin whole batches; a round
-        # only yields once its last batch is full; the tail is completed by
-        # cycling indices from the first `num_processes` batches so every
-        # process ends with the same number of full batches.
-        initial_data: list = []
-        batch_to_yield: list = []
-        idx = -1
-        batch: list = []
-        for idx, batch in enumerate(self.batch_sampler):
-            batch = list(batch)
-            if not self.drop_last and idx < self.num_processes:
-                initial_data += batch
-            if idx % self.num_processes == self.process_index:
-                batch_to_yield = batch
-            if idx % self.num_processes == self.num_processes - 1 and (
-                self.batch_size is None or len(batch) == self.batch_size
-            ):
-                yield batch_to_yield
-                batch_to_yield = []
-        if self.drop_last or len(initial_data) == 0:
+                tail = batch
+        if self.drop_last or not tail:
             return
         if not self.even_batches:
-            if len(batch_to_yield) > 0:
-                yield batch_to_yield
+            if len(tail) > lo:
+                yield tail[lo:hi]
             return
-        # A full batch saved from an incomplete round is still owed to us.
-        if len(batch_to_yield) == self.batch_size:
-            yield batch_to_yield
-        while len(initial_data) < self.num_processes * self.batch_size:
-            initial_data += initial_data
-        # If the stream's last batch was full, its round position is consumed.
-        if len(batch) == self.batch_size:
-            batch = []
-            idx += 1
-        cycle_index = 0
-        while idx % self.num_processes != 0 or len(batch) > 0:
-            end_index = cycle_index + self.batch_size - len(batch)
-            batch += initial_data[cycle_index:end_index]
-            if idx % self.num_processes == self.process_index:
-                yield batch
-            cycle_index = end_index
-            batch = []
-            idx += 1
+        while len(tail) < self.batch_size:
+            tail = tail + head
+        yield tail[lo:hi]
+
+    def _iter_with_no_split(self):
+        # Stream the sampler in ROUNDS of `num_processes` whole batches;
+        # process i owns slot i of every round. A round is emitted only once
+        # its final batch is known full; the unfinished tail round (short
+        # round and/or ragged last batch) is squared up from a pool of
+        # head-of-stream samples so every process ends with the same number
+        # of full batches. Capability parity with reference
+        # data_loader.py:209-253; written against the round formulation.
+        pool: list = []   # samples from the first round, cycled to fill the tail
+        round_: list = [] # batches of the in-progress round
+        for count, raw in enumerate(self.batch_sampler):
+            batch = list(raw)
+            if not self.drop_last and count < self.num_processes:
+                pool.extend(batch)
+            round_.append(batch)
+            # Realign to index-based rounds: a round whose boundary batch was
+            # short never flushes; drop its stale batches instead of letting
+            # round_ grow unbounded and jam the == flush check below.
+            del round_[: -(count % self.num_processes) - 1]
+            if len(round_) == self.num_processes and (
+                self.batch_size is None or len(batch) == self.batch_size
+            ):
+                yield round_[self.process_index]
+                round_ = []
+        if self.drop_last or not pool or not round_:
+            return
+        if not self.even_batches:
+            if self.process_index < len(round_):
+                yield round_[self.process_index]
+            return
+        # Square the tail round: top up the ragged last batch from the pool,
+        # then synthesize whole batches from successive pool slices.
+        while len(pool) < self.num_processes * self.batch_size:
+            pool = pool + pool
+        cursor = 0
+        if len(round_[-1]) < self.batch_size:
+            need = self.batch_size - len(round_[-1])
+            round_[-1] = round_[-1] + pool[:need]
+            cursor = need
+        while len(round_) < self.num_processes:
+            round_.append(pool[cursor : cursor + self.batch_size])
+            cursor += self.batch_size
+        yield round_[self.process_index]
 
 
 class SimpleBatchSampler:
@@ -252,36 +257,32 @@ class IterableDatasetShard:
             self.dataset.set_epoch(epoch)
 
     def __iter__(self):
-        real_batch_size = (
-            self.batch_size if self.split_batches else self.batch_size * self.num_processes
-        )
-        process_batch_size = self.batch_size // self.num_processes if self.split_batches else self.batch_size
-        process_slice = range(
-            self.process_index * process_batch_size, (self.process_index + 1) * process_batch_size
-        )
-        first_batch = None
-        current_batch = []
-        for element in self.dataset:
-            current_batch.append(element)
-            if len(current_batch) == real_batch_size:
-                for i in process_slice:
-                    yield current_batch[i]
-                if first_batch is None:
-                    first_batch = current_batch.copy()
-                current_batch = []
-        if not self.drop_last and len(current_batch) > 0:
-            if not self.even_batches:
-                # yield what belongs to this process from the ragged tail
-                for i in process_slice:
-                    if i < len(current_batch):
-                        yield current_batch[i]
-                return
-            if first_batch is None:
-                first_batch = current_batch.copy()
-            while len(current_batch) < real_batch_size:
-                current_batch += first_batch
-            for i in process_slice:
-                yield current_batch[i]
+        # Window the raw stream into global-batch-sized chunks and emit this
+        # process's contiguous sub-range of each window. The final short
+        # window is squared up by cycling the first window's items
+        # (even_batches) or sliced ragged. Capability parity with reference
+        # data_loader.py:323-353; written against the window formulation.
+        window = self.batch_size if self.split_batches else self.batch_size * self.num_processes
+        per_proc = window // self.num_processes
+        lo, hi = per_proc * self.process_index, per_proc * (self.process_index + 1)
+        head: Optional[list] = None
+        buf: list = []
+        for item in self.dataset:
+            buf.append(item)
+            if len(buf) == window:
+                yield from buf[lo:hi]
+                if head is None:
+                    head = list(buf)
+                buf = []
+        if self.drop_last or not buf:
+            return
+        if not self.even_batches:
+            yield from buf[lo:hi]
+            return
+        pad = head if head is not None else list(buf)
+        while len(buf) < window:
+            buf = buf + pad
+        yield from buf[lo:hi]
 
 
 # ---------------------------------------------------------------------------

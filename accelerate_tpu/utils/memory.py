@@ -56,39 +56,52 @@ def should_reduce_batch_size(exception: Exception) -> bool:
     return any(m in msg for m in OOM_MARKERS)
 
 
+class _BatchSizeFinder:
+    """Callable that sweeps downward (halving) through candidate batch sizes
+    until the wrapped function survives without an accelerator OOM.
+
+    The surviving size is remembered across calls, so a training function
+    re-entered after checkpoint resume does not restart the sweep.
+    """
+
+    def __init__(self, fn, starting_batch_size: int):
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self.batch_size = starting_batch_size
+
+    def _check_signature(self, args):
+        # The finder owns the first positional slot; a caller that also fills
+        # it would silently shift every other argument.
+        accepted = list(inspect.signature(self._fn).parameters)
+        if len(args) + 1 > len(accepted):
+            shown = ", ".join(f"{name}={value!r}" for name, value in zip(accepted[1:], args[1:]))
+            raise TypeError(
+                f"`{self._fn.__name__}` receives its batch size from the decorator — "
+                f"call it without one: `{self._fn.__name__}({shown})`"
+            )
+
+    def __call__(self, *args, **kwargs):
+        self._check_signature(args)
+        clear_device_cache(garbage_collection=True)
+        while self.batch_size > 0:
+            try:
+                return self._fn(self.batch_size, *args, **kwargs)
+            except Exception as err:
+                if not should_reduce_batch_size(err):
+                    raise
+                clear_device_cache(garbage_collection=True)
+                self.batch_size //= 2
+        raise RuntimeError("No executable batch size found, reached zero.")
+
+
 def find_executable_batch_size(function=None, starting_batch_size: int = 128):
     """Decorator: retry ``function(batch_size, ...)`` with halved batch sizes
-    on OOM (reference memory.py:106-161). The wrapped function must take
-    ``batch_size`` as its first argument."""
+    on HBM OOM (capability parity: reference utils/memory.py:106-161; the
+    implementation here is a stateful callable, not the reference's closure).
+    The wrapped function must take ``batch_size`` as its first argument."""
     if function is None:
         return functools.partial(find_executable_batch_size, starting_batch_size=starting_batch_size)
-
-    batch_size = starting_batch_size
-
-    def decorator(*args, **kwargs):
-        nonlocal batch_size
-        clear_device_cache(garbage_collection=True)
-        params = list(inspect.signature(function).parameters.keys())
-        if len(params) < (len(args) + 1):
-            arg_str = ", ".join([f"{arg}={value}" for arg, value in zip(params[1:], args[1:])])
-            raise TypeError(
-                f"Batch size was passed into `{function.__name__}` as the first argument "
-                f"when called.\nRemove this as the decorator already does so: "
-                f"`{function.__name__}({arg_str})`"
-            )
-        while True:
-            if batch_size == 0:
-                raise RuntimeError("No executable batch size found, reached zero.")
-            try:
-                return function(batch_size, *args, **kwargs)
-            except Exception as e:
-                if should_reduce_batch_size(e):
-                    clear_device_cache(garbage_collection=True)
-                    batch_size //= 2
-                else:
-                    raise
-
-    return decorator
+    return _BatchSizeFinder(function, starting_batch_size)
 
 
 def get_hbm_stats(device=None) -> dict:

@@ -1,0 +1,75 @@
+"""OOM-retry decorator tests (reference tests/test_memory_utils.py shape)."""
+
+import pytest
+
+from accelerate_tpu.utils.memory import find_executable_batch_size, should_reduce_batch_size
+
+
+def _fake_oom():
+    raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to allocate")
+
+
+class TestFindExecutableBatchSize:
+    def test_halves_until_fit(self):
+        tried = []
+
+        @find_executable_batch_size(starting_batch_size=128)
+        def run(batch_size):
+            tried.append(batch_size)
+            if batch_size > 16:
+                _fake_oom()
+            return batch_size
+
+        assert run() == 16
+        assert tried == [128, 64, 32, 16]
+
+    def test_passes_through_args(self):
+        @find_executable_batch_size(starting_batch_size=8)
+        def run(batch_size, a, b=2):
+            return (batch_size, a, b)
+
+        assert run(1, b=3) == (8, 1, 3)
+
+    def test_rejects_explicit_batch_size(self):
+        @find_executable_batch_size(starting_batch_size=8)
+        def run(batch_size, lr):
+            return batch_size
+
+        with pytest.raises(TypeError, match="receives its batch size"):
+            run(8, 0.1)
+
+    def test_non_oom_errors_propagate(self):
+        @find_executable_batch_size(starting_batch_size=8)
+        def run(batch_size):
+            raise ValueError("unrelated")
+
+        with pytest.raises(ValueError, match="unrelated"):
+            run()
+
+    def test_reaching_zero_raises(self):
+        @find_executable_batch_size(starting_batch_size=4)
+        def run(batch_size):
+            _fake_oom()
+
+        with pytest.raises(RuntimeError, match="reached zero"):
+            run()
+
+    def test_survivor_remembered_across_calls(self):
+        calls = []
+
+        @find_executable_batch_size(starting_batch_size=64)
+        def run(batch_size):
+            calls.append(batch_size)
+            if batch_size > 8:
+                _fake_oom()
+            return batch_size
+
+        assert run() == 8
+        assert run() == 8
+        assert calls == [64, 32, 16, 8, 8]
+
+
+def test_should_reduce_batch_size():
+    assert should_reduce_batch_size(MemoryError())
+    assert should_reduce_batch_size(RuntimeError("RESOURCE_EXHAUSTED: hbm"))
+    assert not should_reduce_batch_size(ValueError("shape mismatch"))
