@@ -48,4 +48,12 @@ def __getattr__(name):
         from .local_sgd import LocalSGD
 
         return LocalSGD
+    if name in ("skip_first_batches", "prepare_data_loader", "DataLoader"):
+        from . import data
+
+        return getattr(data, name)
+    if name == "find_executable_batch_size":
+        from .utils.memory import find_executable_batch_size
+
+        return find_executable_batch_size
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

@@ -1001,15 +1001,16 @@ class Accelerator:
             data = gather_object(input_data)
         else:
             data = self.gather(input_data)
-        try:
-            if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
-                def _adjust(tensor):
-                    return tensor[: self.gradient_state.remainder]
+        if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
+            def _trim(t):
+                # only batched leaves carry padding; scalars (e.g. a mean
+                # loss) pass through untouched
+                if getattr(t, "ndim", 0) == 0:
+                    return t
+                return t[: self.gradient_state.remainder]
 
-                return recursively_apply(_adjust, data)
-            return data
-        except Exception:
-            return data
+            return recursively_apply(_trim, data)
+        return data
 
     def reduce(self, tensor, reduction="sum", scale=1.0):
         return reduce(tensor, reduction, scale)

@@ -31,7 +31,14 @@ import numpy as np
 from .logging import get_logger
 from .state import GradientState, PartialState
 from .utils.dataclasses import DataLoaderConfiguration, RNGType
-from .utils.operations import concatenate, convert_to_jax, find_batch_size, make_global_batch, recursively_apply
+from .utils.operations import (
+    broadcast_object_list,
+    concatenate,
+    convert_to_jax,
+    find_batch_size,
+    make_global_batch,
+    recursively_apply,
+)
 from .utils.random import default_keychain, synchronize_rng_states
 
 logger = get_logger(__name__)
@@ -517,7 +524,6 @@ class DataLoaderDispatcher(BaseDataLoader):
         self.iteration = 0
 
     def __iter__(self):
-        from .utils.operations import broadcast_object_list
 
         state = PartialState()
         self.begin()
@@ -550,25 +556,65 @@ class DataLoaderDispatcher(BaseDataLoader):
             self.end()
 
     def _fetch_and_share(self, iterator, state):
-        # main process reads the batch; all processes learn the structure,
-        # then the global array is built from main's data only.
+        # main process reads the batch; all processes learn the structure
+        # (+ the real row count of a padded ragged tail), then the global
+        # array is built from main's data only.
         if state.is_main_process:
             try:
                 batch = convert_to_jax(next(iterator))
-                info = [_tree_meta(batch)]
+                batch, real_rows = self._pad_ragged_tail(batch, state)
+                info = [_tree_meta(batch), real_rows]
             except StopIteration:
-                info = [None]
+                info = [None, None]
         else:
-            batch, info = None, [None]
+            batch, info = None, [None, None]
         if state.num_processes > 1:
             info = broadcast_object_list(info)
         if info[0] is None:
             return None
+        if info[1] is not None:
+            # consumed by gather_for_metrics at end_of_dataloader
+            self.remainder = info[1]
         if state.num_processes > 1:
             batch = _scatter_from_main(batch, info[0], self.mesh, state, self.batch_axes)
         elif self.mesh is not None:
             batch = make_global_batch(batch, self.mesh, batch_axes=self.batch_axes)
         return batch
+
+    def _pad_ragged_tail(self, batch, state):
+        """Square up a ragged final global batch by repeating its head rows
+        (reference dispatch even_batches semantics) so every process can take
+        an equal slice and shapes stay static. Returns (batch, real_rows) —
+        real_rows is None when nothing was padded."""
+        rows = find_batch_size(batch)
+        if rows is None:
+            return batch, None
+        if self.batch_size is not None:
+            target = self.batch_size * state.num_processes
+        else:
+            target = -(-rows // state.num_processes) * state.num_processes
+        if rows >= target:
+            return batch, None
+        if not self.even_batches:
+            raise ValueError(
+                f"dispatch_batches with even_batches=False cannot shard a ragged "
+                f"final batch of {rows} rows across {state.num_processes} processes; "
+                "use drop_last=True or keep even_batches=True"
+            )
+
+        def _pad(t):
+            if getattr(t, "ndim", 0) == 0 or t.shape[0] != rows:
+                return t
+            t = np.asarray(t)
+            reps, missing = [t], target - rows
+            while missing > 0:
+                take = min(missing, rows)
+                reps.append(t[:take])
+                missing -= take
+            return np.concatenate(reps, axis=0)
+
+        padded = recursively_apply(_pad, batch, test_type=lambda x: hasattr(x, "shape"))
+        return padded, rows
 
     def __len__(self):
         return len(self.base_loader)
@@ -580,35 +626,52 @@ def _tree_meta(batch):
     )
 
 
-def _scatter_from_main(batch, meta, mesh, state, batch_axes):
-    """Build a global array where only process 0 contributes data; XLA
-    broadcasts over DCN on first use. Non-main hosts pass zero-filled
-    locals of the right shape."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+def _is_meta_leaf(x):
+    """A (shape, dtype) entry produced by _tree_meta — must be treated as a
+    leaf when tree-mapping over the meta structure."""
+    return (
+        isinstance(x, tuple)
+        and len(x) == 2
+        and isinstance(x[0], tuple)
+        and isinstance(x[1], str)
+    )
 
-    axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+
+def _scatter_from_main(batch, meta, mesh, state, batch_axes):
+    """Dispatch-mode scatter: rank 0 read the FULL global batch; every host
+    receives it over DCN, keeps only its own contiguous per-process slice of
+    the batch dimension, and contributes that slice to the assembled global
+    array (reference data_loader.py:731-852 rank0-fetch + slice_fn)."""
+    from .utils.operations import broadcast
 
     def _one(leaf_meta, leaf):
         if not isinstance(leaf_meta, tuple) or len(leaf_meta) != 2:
+            # non-array leaf: main's value was shipped in the meta itself
             return leaf_meta if leaf is None else leaf
         shape, dtype = leaf_meta
-        sharding = NamedSharding(mesh, P(axes))
         if state.is_main_process:
             data = np.asarray(leaf)
         else:
             data = np.zeros(shape, dtype=np.dtype(dtype))
-        # each host contributes an equal slice; main's slice is authoritative
-        # only for its shard — true dispatch therefore requires
-        # broadcast(batch) first:
-        from .utils.operations import broadcast
-
-        data = broadcast(data)
-        local = np.asarray(data)
-        return jax.make_array_from_process_local_data(sharding, local)
+        data = np.asarray(broadcast(data))
+        if data.ndim == 0:
+            return data  # scalar: replicated, nothing to slice
+        rows = data.shape[0]
+        if rows % state.num_processes != 0:
+            raise ValueError(
+                f"dispatch_batches requires the global batch dimension ({rows}) "
+                f"to divide evenly across {state.num_processes} processes"
+            )
+        per = rows // state.num_processes
+        return data[state.process_index * per : (state.process_index + 1) * per]
 
     if state.is_main_process:
-        return jax.tree_util.tree_map(_one, meta, batch)
-    return jax.tree_util.tree_map(lambda m: _one(m, None), meta)
+        local = jax.tree_util.tree_map(_one, meta, batch, is_leaf=_is_meta_leaf)
+    else:
+        local = jax.tree_util.tree_map(lambda m: _one(m, None), meta, is_leaf=_is_meta_leaf)
+    if mesh is not None:
+        return make_global_batch(local, mesh, batch_axes=batch_axes)
+    return local
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,152 @@
+"""Collective-operations assertion program, run under a real `accelerate-tpu
+launch` (parity: reference test_utils/scripts/test_ops.py, 180 LoC).
+
+Covers pytree gather / gather_object / broadcast (incl. non-zero source) /
+broadcast_object_list / reduce sum+mean / pad_across_processes (both ends) /
+pad_input_tensors, and — when launched with `--debug` / debug mode env —
+the desync detector raising DistributedOperationException on mismatched
+operand shapes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def test_gather(accelerator):
+    jnp = _jnp()
+    from accelerate_tpu.utils.operations import gather
+
+    rank, n = accelerator.process_index, accelerator.num_processes
+    tree = {"a": jnp.full((2, 3), float(rank)), "b": (jnp.asarray([rank, rank]),)}
+    out = gather(tree)
+    assert np.asarray(out["a"]).shape == (2 * n, 3)
+    assert sorted(np.asarray(out["a"])[:, 0].tolist()) == sorted(
+        float(r) for r in range(n) for _ in range(2)
+    )
+    assert np.asarray(out["b"][0]).shape == (2 * n,)
+    accelerator.print("gather OK")
+
+
+def test_gather_object(accelerator):
+    from accelerate_tpu.utils.operations import gather_object
+
+    rank, n = accelerator.process_index, accelerator.num_processes
+    out = gather_object([{"rank": rank, "msg": f"hello-{rank}"}])
+    assert len(out) == n
+    assert sorted(o["rank"] for o in out) == list(range(n))
+    accelerator.print("gather_object OK")
+
+
+def test_broadcast(accelerator):
+    jnp = _jnp()
+    from accelerate_tpu.utils.operations import broadcast
+
+    rank, n = accelerator.process_index, accelerator.num_processes
+    src = max(0, n - 1)
+    tree = {"x": jnp.asarray([float(rank * 10 + 1)])}
+    out = broadcast(tree, from_process=src)
+    assert np.asarray(out["x"]).tolist() == [float(src * 10 + 1)], np.asarray(out["x"])
+    accelerator.print("broadcast OK")
+
+
+def test_broadcast_object_list(accelerator):
+    from accelerate_tpu.utils.operations import broadcast_object_list
+
+    rank = accelerator.process_index
+    lst = broadcast_object_list([{"rank": rank}, rank * 2])
+    assert lst[0] == {"rank": 0} and lst[1] == 0, lst
+    accelerator.print("broadcast_object_list OK")
+
+
+def test_reduce(accelerator):
+    jnp = _jnp()
+    from accelerate_tpu.utils.operations import reduce
+
+    rank, n = accelerator.process_index, accelerator.num_processes
+    total = np.asarray(reduce({"v": jnp.asarray([float(rank)])}, reduction="sum")["v"])
+    assert total.tolist() == [float(sum(range(n)))], total
+    mean = np.asarray(reduce(jnp.asarray([float(rank)]), reduction="mean"))
+    assert abs(mean[0] - sum(range(n)) / n) < 1e-6, mean
+    accelerator.print("reduce OK")
+
+
+def test_pad_across_processes(accelerator):
+    jnp = _jnp()
+    from accelerate_tpu.utils.operations import pad_across_processes
+
+    rank, n = accelerator.process_index, accelerator.num_processes
+    ragged = jnp.full((rank + 1, 2), float(rank))
+    padded = pad_across_processes(ragged, dim=0, pad_index=-1.0)
+    assert padded.shape == (n, 2), padded.shape
+    got = np.asarray(padded)
+    assert (got[: rank + 1] == float(rank)).all()
+    assert (got[rank + 1 :] == -1.0).all()
+    padded_first = pad_across_processes(ragged, dim=0, pad_index=-1.0, pad_first=True)
+    got = np.asarray(padded_first)
+    assert (got[: n - rank - 1] == -1.0).all()
+    assert (got[n - rank - 1 :] == float(rank)).all()
+    accelerator.print("pad_across_processes OK")
+
+
+def test_pad_input_tensors(accelerator):
+    jnp = _jnp()
+    from accelerate_tpu.utils.operations import pad_input_tensors
+
+    n = accelerator.num_processes
+    if n == 1:
+        return
+    # batch of n+1 rows padded so it splits evenly across n processes
+    t = jnp.arange(float(n + 1)).reshape(n + 1, 1)
+    out = pad_input_tensors(t, batch_size=n + 1, num_processes=n)
+    assert out.shape[0] % n == 0, out.shape
+    accelerator.print("pad_input_tensors OK")
+
+
+def test_debug_mode_detects_desync(accelerator):
+    """Mismatched gather operand shapes must raise, not hang."""
+    jnp = _jnp()
+    from accelerate_tpu.utils.operations import DistributedOperationException, gather
+
+    if accelerator.num_processes == 1:
+        return
+    rank = accelerator.process_index
+    bad = jnp.ones((rank + 1, 2))  # different shape on every rank
+    try:
+        gather(bad)
+    except DistributedOperationException:
+        accelerator.print("debug desync detection OK")
+        return
+    raise AssertionError("debug mode did not flag mismatched gather shapes")
+
+
+def main():
+    import sys
+
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    if "--check_debug_desync" in sys.argv:
+        test_debug_mode_detects_desync(accelerator)
+    else:
+        test_gather(accelerator)
+        test_gather_object(accelerator)
+        test_broadcast(accelerator)
+        test_broadcast_object_list(accelerator)
+        test_reduce(accelerator)
+        test_pad_across_processes(accelerator)
+        test_pad_input_tensors(accelerator)
+    from accelerate_tpu.state import PartialState
+
+    PartialState().wait_for_everyone()
+    print("ALL OPS CHECKS PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""Gradient-accumulation / sync-semantics assertion program, run under a real
+`accelerate-tpu launch` (parity: reference test_utils/scripts/test_sync.py,
+404 LoC — the no_sync/accumulate matrix).
+
+Asserts, under N real processes:
+- sync_gradients flag pattern for accum k over a dataloader
+- optimizer step count == ceil(batches / k)
+- `sync_each_batch` forces a sync (and an optimizer step) every batch
+- dataloader end forces the final sync even mid-accumulation window
+- accumulated micro-batch training matches big-batch training (same params)
+- params stay bit-identical across processes after every optimizer step
+- no_sync() suppresses the optimizer update
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+
+
+def _fresh_accelerator(**kwargs):
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState
+
+    AcceleratorState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def _setup(accelerator, length=64, batch_size=8, lr=0.05, shuffle=False):
+    from accelerate_tpu.data import DataLoader
+    from accelerate_tpu.test_utils import RegressionDataset, make_regression_model
+
+    model = make_regression_model()
+    optimizer = optax.sgd(lr)
+    dl = DataLoader(RegressionDataset(length=length, seed=7), batch_size=batch_size, shuffle=shuffle)
+    return accelerator.prepare(model, optimizer, dl)
+
+
+def _params_np(model):
+    return {k: np.asarray(v) for k, v in model.params.items()}
+
+
+def _assert_params_synced(accelerator, model):
+    from accelerate_tpu.utils.operations import gather_object
+
+    local = {k: v.tolist() for k, v in _params_np(model).items()}
+    gathered = gather_object([local])
+    for other in gathered[1:]:
+        assert other == gathered[0], f"params diverged across processes: {gathered}"
+
+
+def test_sync_flag_pattern(accelerator_factory, accum_steps: int):
+    from accelerate_tpu import GradientAccumulationPlugin
+
+    accelerator = accelerator_factory(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=accum_steps)
+    )
+    model, optimizer, dl = _setup(accelerator, length=48, batch_size=8)
+    n_batches = len(dl)
+    flags, steps0 = [], model._engine.step_count
+    for batch in dl:
+        with accelerator.accumulate(model):
+            out = model(batch["x"], batch["y"])
+            accelerator.backward(out["loss"])
+            flags.append(accelerator.sync_gradients)
+            optimizer.step()
+            optimizer.zero_grad()
+    expected = [((i + 1) % accum_steps == 0) or (i == n_batches - 1) for i in range(n_batches)]
+    assert flags == expected, (accum_steps, flags, expected)
+    assert model._engine.step_count - steps0 == sum(expected)
+    _assert_params_synced(accelerator, model)
+    accelerator.print(f"sync flag pattern OK (accum={accum_steps}, {sum(expected)} steps)")
+
+
+def test_sync_each_batch(accelerator_factory):
+    from accelerate_tpu import GradientAccumulationPlugin
+
+    accelerator = accelerator_factory(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=4, sync_each_batch=True)
+    )
+    model, optimizer, dl = _setup(accelerator, length=32, batch_size=8)
+    flags = []
+    for batch in dl:
+        with accelerator.accumulate(model):
+            out = model(batch["x"], batch["y"])
+            accelerator.backward(out["loss"])
+            flags.append(accelerator.sync_gradients)
+            optimizer.step()
+            optimizer.zero_grad()
+    assert all(flags), flags
+    _assert_params_synced(accelerator, model)
+    accelerator.print("sync_each_batch OK")
+
+
+def test_dataloader_end_forces_sync(accelerator_factory):
+    """3 batches with accum=2: batch 3 must sync even though the window is open."""
+    from accelerate_tpu import GradientAccumulationPlugin
+
+    accelerator = accelerator_factory(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=2)
+    )
+    # 3 batches per process: an odd count leaves the accum window open at the end
+    length = 8 * accelerator.num_processes * 3
+    model, optimizer, dl = _setup(accelerator, length=length, batch_size=8)
+    assert len(dl) == 3, len(dl)
+    flags = []
+    for batch in dl:
+        with accelerator.accumulate(model):
+            out = model(batch["x"], batch["y"])
+            accelerator.backward(out["loss"])
+            flags.append(accelerator.sync_gradients)
+            optimizer.step()
+            optimizer.zero_grad()
+    assert flags[-1] is True, flags
+    accelerator.print(f"dataloader-end sync OK ({flags})")
+
+
+def test_accumulation_matches_big_batch(accelerator_factory):
+    from accelerate_tpu import GradientAccumulationPlugin
+
+    def run(accum, batch_size):
+        accelerator = accelerator_factory(
+            gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=accum)
+        )
+        model, optimizer, dl = _setup(accelerator, length=32, batch_size=batch_size)
+        for batch in dl:
+            with accelerator.accumulate(model):
+                out = model(batch["x"], batch["y"])
+                accelerator.backward(out["loss"])
+                optimizer.step()
+                optimizer.zero_grad()
+        return _params_np(model)
+
+    p_micro = run(accum=2, batch_size=8)
+    p_big = run(accum=1, batch_size=16)
+    for key in p_micro:
+        np.testing.assert_allclose(p_micro[key], p_big[key], rtol=2e-4)
+    print(f"accumulation == big batch OK (rank view)")
+
+
+def test_no_sync_suppresses_update(accelerator_factory):
+    accelerator = accelerator_factory()
+    model, optimizer, dl = _setup(accelerator, length=16, batch_size=8)
+    before = _params_np(model)
+    batch = next(iter(dl))
+    with accelerator.no_sync(model):
+        out = model(batch["x"], batch["y"])
+        accelerator.backward(out["loss"])
+        optimizer.step()
+        optimizer.zero_grad()
+    after = _params_np(model)
+    for key in before:
+        np.testing.assert_array_equal(before[key], after[key])
+    accelerator.print("no_sync suppresses update OK")
+
+
+def main():
+    factory = _fresh_accelerator
+    for accum in (1, 2, 3):
+        test_sync_flag_pattern(factory, accum)
+    test_sync_each_batch(factory)
+    test_dataloader_end_forces_sync(factory)
+    test_accumulation_matches_big_batch(factory)
+    test_no_sync_suppresses_update(factory)
+    from accelerate_tpu.state import PartialState
+
+    PartialState().wait_for_everyone()
+    print("ALL SYNC CHECKS PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -442,3 +442,32 @@ class TestMidStreamShortBatches:
 
         out = list(BatchSamplerShard(Weird(), num_processes=2, process_index=0, split_batches=True))
         assert out == [[0, 1], [6, 7]]
+
+
+class TestDispatcherSingleProcess:
+    def test_ragged_tail_padded_and_deduped(self):
+        import jax
+
+        from accelerate_tpu.accelerator import Accelerator
+        from accelerate_tpu.data import DataLoader, DataLoaderDispatcher
+
+        acc = Accelerator()
+        ds = _ArrayDataset(19)  # 2 full batches of 8 + ragged 3
+        dl = DataLoaderDispatcher(DataLoader(ds, batch_size=8), mesh=acc.mesh, batch_size=8)
+        total = 0
+        for b in dl:
+            assert np.asarray(b["x"]).shape[0] == 8
+            total += np.asarray(acc.gather_for_metrics(b["x"])).shape[0]
+        assert total == 19
+
+    def test_gather_for_metrics_scalar_leaf_passthrough(self):
+        import jax.numpy as jnp
+
+        from accelerate_tpu.accelerator import Accelerator
+        from accelerate_tpu.data import DataLoader
+
+        acc = Accelerator()
+        dl = acc.prepare(DataLoader(_ArrayDataset(19), batch_size=8))
+        for b in dl:
+            out = acc.gather_for_metrics({"loss": jnp.float32(1.5), "x": b["x"]})
+            assert float(out["loss"]) == 1.5

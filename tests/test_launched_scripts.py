@@ -1,0 +1,47 @@
+"""Launched-assertion tests: run the bundled distributed scripts under a real
+`accelerate-tpu launch --cpu --num_processes N` (reference tests/test_multigpu.py
+pattern — host builds the launch command, assertions live in the script)."""
+
+import pytest
+
+from accelerate_tpu.test_utils.testing import (
+    get_launch_command,
+    execute_subprocess,
+    path_in_accelerate_package,
+    run_launched_script,
+)
+
+
+@pytest.mark.slow
+class TestLaunchedOps:
+    def test_ops_two_processes(self):
+        r = run_launched_script(("test_utils", "scripts", "test_ops.py"), num_processes=2)
+        assert "ALL OPS CHECKS PASSED" in r.stdout
+
+    def test_debug_desync_detection(self):
+        script = path_in_accelerate_package("test_utils", "scripts", "test_ops.py")
+        cmd = get_launch_command(num_processes=2) + ["--debug", script, "--check_debug_desync"]
+        r = execute_subprocess(cmd)
+        assert "ALL OPS CHECKS PASSED" in r.stdout
+
+
+@pytest.mark.slow
+class TestLaunchedSync:
+    def test_sync_two_processes(self):
+        r = run_launched_script(("test_utils", "scripts", "test_sync.py"), num_processes=2)
+        assert "ALL SYNC CHECKS PASSED" in r.stdout
+
+
+@pytest.mark.slow
+class TestLaunchedDataLoop:
+    def test_data_loop_two_processes(self):
+        r = run_launched_script(
+            ("test_utils", "scripts", "test_distributed_data_loop.py"), num_processes=2
+        )
+        assert "ALL DATA-LOOP CHECKS PASSED" in r.stdout
+
+    def test_data_loop_four_processes(self):
+        r = run_launched_script(
+            ("test_utils", "scripts", "test_distributed_data_loop.py"), num_processes=4
+        )
+        assert "ALL DATA-LOOP CHECKS PASSED" in r.stdout
