@@ -37,6 +37,10 @@ class DecoderConfig:
     remat: bool = True
     scan_layers: bool = True
     fused_ce_chunks: int = 8
+    # pipeline parallelism over the mesh "stage" axis: stage-stacked layer
+    # params + GPipe microbatch schedule (parallel/pipeline.py)
+    pipeline_stages: int = 1
+    pipeline_microbatches: Optional[int] = None  # None -> pipeline_stages
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -46,6 +50,11 @@ class DecoderConfig:
         if self.mlp_dim is None:
             raw = int(self.embed_dim * 8 / 3)
             self.mlp_dim = (raw + 255) // 256 * 256
+        if self.pipeline_stages > 1 and self.num_layers % self.pipeline_stages != 0:
+            raise ValueError(
+                f"pipeline_stages={self.pipeline_stages} must divide "
+                f"num_layers={self.num_layers} evenly"
+            )
 
     @property
     def num_params(self) -> int:

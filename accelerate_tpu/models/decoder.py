@@ -15,6 +15,7 @@ targets (reference examples/nlp_example.py, benchmarks/big_model_inference).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import flax.linen as nn
@@ -30,30 +31,11 @@ from .configs import DecoderConfig
 
 
 def _constrain(x, names, mesh: Optional[Mesh], rules=DEFAULT_AXIS_RULES):
-    """Pin an activation's sharding (no-op without a multi-device mesh).
+    """Pin an activation's sharding (lives in parallel/sharding.py; this
+    alias is the intra-package spelling used by the model files)."""
+    from ..parallel.sharding import constrain_activation
 
-    Mesh axes that don't divide the actual dim are dropped (a batch of 1 at
-    init/eval time must not demand fsdp-divisibility)."""
-    if mesh is None or mesh.size == 1:
-        return x
-    from jax.sharding import PartitionSpec as P
-
-    spec = logical_to_spec(names, rules, mesh)
-    parts = []
-    for i, dim in enumerate(x.shape):
-        entry = spec[i] if i < len(spec) else None
-        if entry is None:
-            parts.append(None)
-            continue
-        axes = entry if isinstance(entry, tuple) else (entry,)
-        kept, prod = [], 1
-        for ax in axes:
-            n = mesh.shape[ax]
-            if dim % (prod * n) == 0:
-                kept.append(ax)
-                prod *= n
-        parts.append(tuple(kept) if len(kept) > 1 else (kept[0] if kept else None))
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*parts)))
+    return constrain_activation(x, names, mesh, rules)
 
 
 def _dense_init(scale: float = 1.0):
@@ -144,6 +126,30 @@ class _ScanBlock(nn.Module):
         return (x, sin, cos, deterministic), None
 
 
+class StageStack(nn.Module):
+    """One pipeline stage: the layer-scan over num_layers/pipeline_stages
+    blocks. Used as the stage body of parallel/pipeline.PipelineStages."""
+
+    config: DecoderConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, sin, cos, deterministic: bool = True):
+        cfg = self.config
+        body = _ScanBlock
+        if cfg.remat:
+            body = nn.remat(body, prevent_cse=False, static_argnums=())
+        Stack = nn.scan(
+            body,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.num_layers // cfg.pipeline_stages,
+            metadata_params={nn.PARTITION_NAME: "layer"},
+        )
+        (x, _, _, _), _ = Stack(cfg, self.mesh, name="layers")((x, sin, cos, deterministic), None)
+        return x
+
+
 class DecoderLM(nn.Module):
     """Causal LM. __call__(input_ids[, labels]) -> {"logits"|"loss", ...}.
 
@@ -177,7 +183,33 @@ class DecoderLM(nn.Module):
         sin, cos = rotary_embedding_tables(positions, cfg.head_dim, theta=cfg.rope_theta, dtype=cfg.dtype)
 
         block_cls = DecoderBlock
-        if cfg.scan_layers:
+        num_stages = self._effective_stages()
+        if num_stages > 1:
+            from ..parallel.pipeline import (
+                PipelineStages,
+                merge_microbatches,
+                split_microbatches,
+            )
+
+            if cfg.pipeline_stages <= 1:
+                cfg = dataclasses.replace(cfg, pipeline_stages=num_stages)
+            num_micro = cfg.pipeline_microbatches or num_stages
+            # M only affects the schedule (params are per-stage, not per-M):
+            # adapt it down to the largest count dividing this batch so odd
+            # batches (init's batch_size=1, ragged eval) still trace.
+            while b % num_micro != 0:
+                num_micro -= 1
+            x_mb = split_microbatches(x, num_micro)
+            x = PipelineStages(
+                stage_module=StageStack,
+                stage_args=(cfg, self.mesh),
+                num_stages=num_stages,
+                num_microbatches=num_micro,
+                mesh=self.mesh,
+                name="pipeline",
+            )(x_mb, sin, cos, deterministic)
+            x = merge_microbatches(x)
+        elif cfg.scan_layers:
             scan_body = _ScanBlock
             if cfg.remat:
                 scan_body = nn.remat(
@@ -228,6 +260,22 @@ class DecoderLM(nn.Module):
             return {"loss": loss}
         logits = (x @ vocab_kernel).astype(jnp.float32)
         return {"logits": _constrain(logits, ("batch", "seq", "vocab"), self.mesh)}
+
+    def _effective_stages(self) -> int:
+        """Pipeline degree: explicit config wins; otherwise a mesh with a
+        real "stage" axis (ShardingConfig(pipeline_parallel=k)) turns the
+        pipeline path on automatically."""
+        cfg = self.config
+        if cfg.pipeline_stages > 1:
+            return cfg.pipeline_stages
+        if (
+            self.mesh is not None
+            and cfg.scan_layers
+            and self.mesh.shape.get("stage", 1) > 1
+            and cfg.num_layers % self.mesh.shape["stage"] == 0
+        ):
+            return self.mesh.shape["stage"]
+        return 1
 
     def init_variables(self, rng: jax.Array, batch_size: int = 1, seq_len: Optional[int] = None):
         seq_len = seq_len or min(self.config.max_seq_len, 128)

@@ -1,0 +1,207 @@
+"""Pipeline parallelism over the "stage" mesh axis.
+
+The reference delegates PP-training to Megatron's microbatch fwd/bwd schedule
+(/root/reference/src/accelerate/utils/megatron_lm.py:926-1033) and ships
+PP-inference via torch pipelining (`prepare_pippy`,
+/root/reference/src/accelerate/inference.py:73-184). The TPU-native design
+is different and much smaller: a GPipe schedule expressed as pure array ops
+under GSPMD —
+
+- stage parameters are created by `nn.vmap` with a leading dim S sharded
+  over the mesh "stage" axis (each device group holds only its stage's
+  layers);
+- a circular activation buffer `[S, mb, ...]`, also stage-sharded, advances
+  one stage per step; the shift is a `concatenate` of the previous step's
+  outputs, which the SPMD partitioner lowers to a neighbor
+  `CollectivePermute` over ICI — no hand-written send/recv;
+- the time loop is `nn.scan` with broadcast params, so compile time is O(1)
+  in schedule length and reverse-mode AD gives the standard GPipe backward
+  (reverse schedule) for free.
+
+Microbatches fill the pipeline (M >= S keeps the bubble at S-1/M+S-1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+
+def pipeline_round_trip_steps(num_microbatches: int, num_stages: int) -> int:
+    """GPipe schedule length: fill (S-1) + stream (M)."""
+    return num_microbatches + num_stages - 1
+
+
+class PipelineStages(nn.Module):
+    """Runs S copies of ``stage_module`` (one per pipeline stage) over a
+    stage-major activation buffer via the GPipe shift schedule.
+
+    ``stage_module`` must be an nn.Module class whose __call__ maps
+    (x, *consts) -> y with y.shape == x.shape. Its parameters gain a leading
+    stage dim (logical axis "stage").
+    """
+
+    stage_module: type
+    stage_args: tuple
+    num_stages: int
+    num_microbatches: int
+    mesh: Optional[Mesh] = None
+    # logical axes of the [stage, microbatch, ...] activation buffer; callers
+    # with non-[b,s,e] stage bodies supply their own
+    buffer_logical_axes: tuple = ("stage", "batch", "seq", "embed")
+
+    @nn.compact
+    def __call__(self, x_microbatches: jax.Array, *consts):
+        S, M = self.num_stages, self.num_microbatches
+        steps = pipeline_round_trip_steps(M, S)
+
+        # Stage-vmapped module: params [S, ...] with partition name "stage".
+        Stages = nn.vmap(
+            self.stage_module,
+            in_axes=(0,) + (None,) * len(consts),
+            out_axes=0,
+            axis_size=S,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            metadata_params={nn.PARTITION_NAME: "stage"},
+        )
+
+        outer = self
+
+        class _Step(nn.Module):
+            @nn.compact
+            def __call__(self, carry, t):
+                buffer, outputs = carry
+                y = Stages(*outer.stage_args, name="stages")(buffer, *consts)
+                y = outer._constrain_buffer(y)
+                # the last stage finished microbatch t-(S-1) at this step
+                out_idx = t - (S - 1)
+                clamped = jnp.clip(out_idx, 0, M - 1)
+                current = jax.lax.dynamic_index_in_dim(outputs, clamped, 0, keepdims=False)
+                done = jnp.where(out_idx >= 0, y[-1], current)
+                outputs = jax.lax.dynamic_update_index_in_dim(outputs, done, clamped, 0)
+                # advance the belt: stage 0 takes the next microbatch, stage
+                # i takes stage i-1's output (a neighbor collective-permute)
+                nxt = jnp.clip(t + 1, 0, M - 1)
+                feed = jax.lax.dynamic_index_in_dim(x_microbatches, nxt, 0, keepdims=False)
+                feed = jnp.where(t + 1 < M, feed, jnp.zeros_like(feed))
+                buffer = jnp.concatenate([feed[None], y[:-1]], axis=0)
+                buffer = outer._constrain_buffer(buffer)
+                return (buffer, outputs), None
+
+        TimeLoop = nn.scan(
+            _Step,
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+            length=steps,
+        )
+
+        mb_shape = x_microbatches.shape[1:]
+        buffer0 = jnp.concatenate(
+            [
+                x_microbatches[:1],
+                jnp.zeros((S - 1,) + mb_shape, x_microbatches.dtype),
+            ],
+            axis=0,
+        )
+        buffer0 = self._constrain_buffer(buffer0)
+        outputs0 = jnp.zeros_like(x_microbatches)
+        (_, outputs), _ = TimeLoop(name="schedule")(
+            (buffer0, outputs0), jnp.arange(steps)
+        )
+        return outputs
+
+    def _constrain_buffer(self, buf):
+        from .sharding import constrain_activation
+
+        return constrain_activation(buf, self.buffer_logical_axes, self.mesh)
+
+
+def split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...] (consecutive rows per microbatch)."""
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(
+            f"batch {b} is not divisible by num_microbatches={num_microbatches}"
+        )
+    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+
+def merge_microbatches(y: jax.Array) -> jax.Array:
+    """[M, mb, ...] -> [B, ...]."""
+    return y.reshape(y.shape[0] * y.shape[1], *y.shape[2:])
+
+
+def stack_layers_to_stages(stacked_params, num_stages: int):
+    """Reshape the leaves of a LAYER-SCANNED SUBTREE ([L, ...] on dim 0)
+    into stage-major [S, L/S, ...]. Apply only to the scan subtree — a full
+    param tree contains non-layer leaves (embedding, norms) that would be
+    silently mis-reshaped. For full trees use
+    :func:`remap_params_to_pipeline`."""
+
+    def _one(leaf):
+        if not hasattr(leaf, "shape") or leaf.ndim < 1:
+            return leaf
+        L = leaf.shape[0]
+        if L % num_stages != 0:
+            raise ValueError(f"layer count {L} not divisible by {num_stages} stages")
+        return leaf.reshape(num_stages, L // num_stages, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(_one, stacked_params)
+
+
+def stages_to_stack_layers(staged_params):
+    """Inverse of :func:`stack_layers_to_stages` (leaves [S, L/S, ...] ->
+    [L, ...]); same caveat — scan-subtree leaves only."""
+
+    def _one(leaf):
+        if not hasattr(leaf, "shape") or leaf.ndim < 2:
+            return leaf
+        return leaf.reshape(leaf.shape[0] * leaf.shape[1], *leaf.shape[2:])
+
+    return jax.tree_util.tree_map(_one, staged_params)
+
+
+def _flatten_paths(tree):
+    from flax.traverse_util import flatten_dict
+
+    return flatten_dict(tree, sep="/")
+
+
+def _unflatten_paths(flat):
+    from flax.traverse_util import unflatten_dict
+
+    return unflatten_dict(flat, sep="/")
+
+
+def remap_params_to_pipeline(dense_params, pipe_params_template, num_stages: int):
+    """Re-layout a layer-scanned param tree ([L, ...] leaves under a
+    "layers" scan) into the pipeline tree (leaves [S, L/S, ...] under
+    pipeline/.../stages/layers) by path-suffix matching. Non-stage params
+    (embedding, final norm, lm head) keep their paths.
+
+    Used by `prepare_pippy` to run a model trained without PP under
+    pipelined inference."""
+    dense_flat = _flatten_paths(dense_params)
+    pipe_flat = _flatten_paths(
+        jax.tree_util.tree_map(lambda x: x, pipe_params_template)
+    )
+
+    def _match(pipe_path, template_leaf):
+        if "stages/layers/" in pipe_path:
+            tail = pipe_path.split("stages/layers/")[-1]
+            for dense_path, dense_leaf in dense_flat.items():
+                if dense_path.endswith(tail) and "layers/" in dense_path:
+                    return jnp.asarray(dense_leaf).reshape(template_leaf.shape)
+            raise KeyError(f"no dense param matches pipeline path {pipe_path}")
+        if pipe_path in dense_flat:
+            return jnp.asarray(dense_flat[pipe_path])
+        raise KeyError(f"no dense param for non-stage pipeline path {pipe_path}")
+
+    return _unflatten_paths(
+        {path: _match(path, leaf) for path, leaf in pipe_flat.items()}
+    )

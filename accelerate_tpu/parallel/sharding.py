@@ -163,6 +163,32 @@ def infer_opt_state_sharding(optimizer, params, param_sharding, mesh: Mesh):
     return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
 
+def constrain_activation(x, logical_names: tuple, mesh: Optional[Mesh], rules=None):
+    """Pin an activation's sharding via logical axis names (no-op without a
+    multi-device mesh). Mesh axes that don't divide the actual dim are
+    dropped — a batch of 1 at init/eval time must not demand
+    fsdp-divisibility."""
+    if mesh is None or mesh.size == 1:
+        return x
+    rules = rules or DEFAULT_AXIS_RULES
+    spec = logical_to_spec(logical_names, rules, mesh)
+    parts = []
+    for i, dim in enumerate(x.shape):
+        entry = spec[i] if i < len(spec) else None
+        if entry is None:
+            parts.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept, prod = [], 1
+        for ax in axes:
+            n = mesh.shape[ax]
+            if dim % (prod * n) == 0:
+                kept.append(ax)
+                prod *= n
+        parts.append(tuple(kept) if len(kept) > 1 else (kept[0] if kept else None))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*parts)))
+
+
 def batch_spec(mesh: Mesh, extra_sequence_axis: bool = False) -> P:
     axes = tuple(a for a in ("replica", "data", "fsdp") if a in mesh.axis_names)
     if extra_sequence_axis and "sequence" in mesh.axis_names and mesh.shape["sequence"] > 1:
