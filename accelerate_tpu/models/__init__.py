@@ -9,10 +9,13 @@ so we ship first-class implementations:
   the flagship training model (maps to reference GPT benchmarks).
 - ``EncoderClassifier`` — BERT-family sequence classifier
   (reference `examples/nlp_example.py` target, BASELINE.md).
+- ``MoeMLP`` — mixture-of-experts FFN with expert parallelism over the
+  mesh "expert" axis (enabled via ``DecoderConfig.moe_num_experts``).
 """
 
 from .configs import DecoderConfig, EncoderConfig
 from .decoder import DecoderLM
 from .encoder import EncoderClassifier
+from .moe import MoeMLP
 
-__all__ = ["DecoderConfig", "EncoderConfig", "DecoderLM", "EncoderClassifier"]
+__all__ = ["DecoderConfig", "EncoderConfig", "DecoderLM", "EncoderClassifier", "MoeMLP"]

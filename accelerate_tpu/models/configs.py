@@ -41,6 +41,12 @@ class DecoderConfig:
     # params + GPipe microbatch schedule (parallel/pipeline.py)
     pipeline_stages: int = 1
     pipeline_microbatches: Optional[int] = None  # None -> pipeline_stages
+    # mixture-of-experts FFN over the mesh "expert" axis (models/moe.py);
+    # 0 = dense MLP
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -55,6 +61,18 @@ class DecoderConfig:
                 f"pipeline_stages={self.pipeline_stages} must divide "
                 f"num_layers={self.num_layers} evenly"
             )
+        if self.moe_num_experts == 1:
+            raise ValueError("moe_num_experts must be 0 (dense) or >= 2")
+        if self.moe_num_experts > 1 and not (1 <= self.moe_top_k <= self.moe_num_experts):
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be in [1, moe_num_experts="
+                f"{self.moe_num_experts}]"
+            )
+        if self.moe_num_experts > 1 and self.pipeline_stages > 1:
+            raise NotImplementedError(
+                "MoE + pipeline parallelism in one model is not wired yet "
+                "(the pipeline buffer does not carry the router aux loss)"
+            )
 
     @property
     def num_params(self) -> int:
@@ -68,7 +86,11 @@ class DecoderConfig:
             self.vocab_size,
         )
         attn = e * h * d + 2 * e * kv * d + h * d * e
-        mlp = 3 * e * m
+        if self.moe_num_experts > 1:
+            # per-expert gate/up/down + the router
+            mlp = self.moe_num_experts * 3 * e * m + e * self.moe_num_experts
+        else:
+            mlp = 3 * e * m
         norms = 2 * e
         per_layer = attn + mlp + norms
         embed = v * e

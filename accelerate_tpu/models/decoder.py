@@ -93,6 +93,9 @@ class DecoderMLP(nn.Module):
 
 
 class DecoderBlock(nn.Module):
+    """Returns (x, aux_loss) — aux_loss is the MoE router load-balancing
+    term (0.0 for dense MLP blocks)."""
+
     config: DecoderConfig
     mesh: Optional[Mesh] = None
 
@@ -107,10 +110,16 @@ class DecoderBlock(nn.Module):
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
         x = x + y
         y = rms_norm(x, ln2, cfg.norm_eps)
-        y = DecoderMLP(cfg, self.mesh, name="mlp")(y)
+        if cfg.moe_num_experts > 1:
+            from .moe import MoeMLP
+
+            y, aux = MoeMLP(cfg, self.mesh, name="moe_mlp")(y)
+        else:
+            y = DecoderMLP(cfg, self.mesh, name="mlp")(y)
+            aux = jnp.float32(0.0)
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
-        return x + y
+        return x + y, aux
 
 
 class _ScanBlock(nn.Module):
@@ -121,9 +130,9 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, sin, cos, deterministic = carry
-        x = DecoderBlock(self.config, self.mesh, name="block")(x, sin, cos, deterministic)
-        return (x, sin, cos, deterministic), None
+        x, aux, sin, cos, deterministic = carry
+        x, block_aux = DecoderBlock(self.config, self.mesh, name="block")(x, sin, cos, deterministic)
+        return (x, aux + block_aux, sin, cos, deterministic), None
 
 
 class StageStack(nn.Module):
@@ -146,7 +155,9 @@ class StageStack(nn.Module):
             length=cfg.num_layers // cfg.pipeline_stages,
             metadata_params={nn.PARTITION_NAME: "layer"},
         )
-        (x, _, _, _), _ = Stack(cfg, self.mesh, name="layers")((x, sin, cos, deterministic), None)
+        (x, _, _, _, _), _ = Stack(cfg, self.mesh, name="layers")(
+            (x, jnp.float32(0.0), sin, cos, deterministic), None
+        )
         return x
 
 
@@ -183,6 +194,7 @@ class DecoderLM(nn.Module):
         sin, cos = rotary_embedding_tables(positions, cfg.head_dim, theta=cfg.rope_theta, dtype=cfg.dtype)
 
         block_cls = DecoderBlock
+        moe_aux = jnp.float32(0.0)  # router load-balance loss, summed over layers
         num_stages = self._effective_stages()
         if num_stages > 1:
             from ..parallel.pipeline import (
@@ -224,14 +236,15 @@ class DecoderLM(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layer"},
             )
-            (x, _, _, _), _ = ScanStack(cfg, self.mesh, name="layers")(
-                (x, sin, cos, deterministic), None
+            (x, moe_aux, _, _, _), _ = ScanStack(cfg, self.mesh, name="layers")(
+                (x, jnp.float32(0.0), sin, cos, deterministic), None
             )
         else:
             if cfg.remat:
                 block_cls = nn.remat(DecoderBlock, prevent_cse=True)
             for i in range(cfg.num_layers):
-                x = block_cls(cfg, self.mesh, name=f"layer_{i}")(x, sin, cos, deterministic)
+                x, block_aux = block_cls(cfg, self.mesh, name=f"layer_{i}")(x, sin, cos, deterministic)
+                moe_aux = moe_aux + block_aux
 
         ln_f = self.param("ln_final", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         x = rms_norm(x, ln_f, cfg.norm_eps)
@@ -257,9 +270,14 @@ class DecoderLM(nn.Module):
                 ignore_index=-100,
                 num_chunks=cfg.fused_ce_chunks,
             )
+            if cfg.moe_num_experts > 1:
+                aux = cfg.moe_aux_loss_weight * moe_aux / cfg.num_layers
+                return {"loss": loss + aux, "lm_loss": loss, "aux_loss": aux}
             return {"loss": loss}
-        logits = (x @ vocab_kernel).astype(jnp.float32)
-        return {"logits": _constrain(logits, ("batch", "seq", "vocab"), self.mesh)}
+        out = {"logits": _constrain((x @ vocab_kernel).astype(jnp.float32), ("batch", "seq", "vocab"), self.mesh)}
+        if cfg.moe_num_experts > 1:
+            out["aux_loss"] = cfg.moe_aux_loss_weight * moe_aux / cfg.num_layers
+        return out
 
     def _effective_stages(self) -> int:
         """Pipeline degree: explicit config wins; otherwise a mesh with a

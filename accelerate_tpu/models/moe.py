@@ -1,0 +1,137 @@
+"""Mixture-of-Experts FFN with expert parallelism over the mesh "expert" axis.
+
+The reference only passes MoE through to DeepSpeed
+(/root/reference/src/accelerate/utils/dataclasses.py:978-984,
+`transformer_moe_cls_names`); there is no in-repo MoE runtime. This is a
+fresh TPU-first design (SURVEY §2.3 EP row): GShard/Switch-style
+capacity-bounded routing expressed as einsums —
+
+- tokens are routed per GROUP (one group per batch row), so the dispatch
+  tensors are [groups, group_size, experts, capacity] with capacity
+  independent of the global batch — memory stays linear in tokens;
+- per-expert FFN weights carry the logical axis ("expert", ...) and shard
+  over the mesh "expert" axis (each device group holds only its experts);
+- the grouped dispatch/combine einsums against batch-sharded activations
+  and expert-sharded weights are what GSPMD lowers to the all-to-all over
+  ICI — no hand-written collective;
+- the router runs in fp32 (numerics, with int32 queue positions so routing
+  stays exact at any batch size) and contributes the Switch load-balancing
+  auxiliary loss.
+
+Capacity keeps shapes static (XLA requirement): each expert accepts at most
+`capacity` tokens per group; overflow tokens fall through with a zero
+expert contribution (their residual path still carries them).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops.layers import swiglu
+from .configs import DecoderConfig
+
+
+def compute_capacity(group_size: int, num_experts: int, top_k: int, factor: float) -> int:
+    """Static per-expert queue length within one routing group."""
+    return max(1, int(group_size * top_k * factor / num_experts))
+
+
+def top_k_routing(
+    router_probs: jax.Array,  # [groups, group_size, experts] fp32
+    top_k: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Build (dispatch [g,n,e,c], combine [g,n,e,c], aux_loss).
+
+    Queue positions are assigned in token order per (group, expert) — first
+    come, first served; slots beyond `capacity` are dropped. The aux loss is
+    the Switch load-balancing term E * sum_e f_e * P_e (==1 at perfect
+    balance), averaged over groups.
+    """
+    g, n, num_experts = router_probs.shape
+    gate_vals, gate_idx = jax.lax.top_k(router_probs, top_k)  # [g, n, k]
+    gate_vals = gate_vals / jnp.maximum(jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+
+    # slot -> expert one-hot, token-major then slot-major so queue positions
+    # are deterministic; int32 cumsum keeps positions exact at any size
+    slot_onehot = jax.nn.one_hot(gate_idx, num_experts, dtype=jnp.int32)  # [g, n, k, e]
+    flat = slot_onehot.reshape(g, n * top_k, num_experts)
+    queue_pos = jnp.cumsum(flat, axis=1) - flat  # position within expert queue
+    pos = jnp.sum(queue_pos * flat, axis=-1).reshape(g, n, top_k)  # [g, n, k]
+    keep = (pos < capacity).astype(jnp.float32)
+
+    expert_onehot = slot_onehot.astype(jnp.float32)
+    pos_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [g, n, k, c]
+    # dispatch[g,n,e,c] = sum_k expert_onehot[g,n,k,e] * pos_onehot[g,n,k,c] * keep
+    dispatch = jnp.einsum("gnke,gnkc,gnk->gnec", expert_onehot, pos_onehot, keep)
+    combine = jnp.einsum("gnke,gnkc,gnk,gnk->gnec", expert_onehot, pos_onehot, keep, gate_vals)
+
+    # Switch aux loss on top-1 assignment, averaged over groups
+    top1 = jax.nn.one_hot(gate_idx[..., 0], num_experts, dtype=jnp.float32)  # [g, n, e]
+    fraction_routed = jnp.mean(top1, axis=1)  # [g, e]
+    mean_prob = jnp.mean(router_probs, axis=1)  # [g, e]
+    aux_loss = num_experts * jnp.mean(jnp.sum(fraction_routed * mean_prob, axis=-1))
+    return dispatch, combine, aux_loss
+
+
+class MoeMLP(nn.Module):
+    """Drop-in replacement for DecoderMLP returning (y, aux_loss)."""
+
+    config: DecoderConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        from .decoder import _constrain, _dense_init
+
+        cfg = self.config
+        E, k = cfg.moe_num_experts, cfg.moe_top_k
+        b, s, d = x.shape
+        m = cfg.mlp_dim
+        dt = cfg.dtype
+
+        router_w = self.param(
+            "router",
+            nn.with_logical_partitioning(_dense_init(), ("embed", "router_experts")),
+            (d, E),
+        )
+        wg = self.param(
+            "w_gate",
+            nn.with_logical_partitioning(_dense_init(), ("expert", "embed", "mlp")),
+            (E, d, m),
+        )
+        wu = self.param(
+            "w_up",
+            nn.with_logical_partitioning(_dense_init(), ("expert", "embed", "mlp")),
+            (E, d, m),
+        )
+        wd = self.param(
+            "w_down",
+            nn.with_logical_partitioning(_dense_init(), ("expert", "mlp", "embed")),
+            (E, m, d),
+        )
+
+        # one routing group per batch row: dispatch stays [b, s, E, c] with
+        # c = O(s), independent of the global batch size
+        logits = jnp.einsum("gnd,de->gne", x.astype(jnp.float32), router_w.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        capacity = compute_capacity(s, E, k, cfg.moe_capacity_factor)
+        dispatch, combine, aux_loss = top_k_routing(probs, k, capacity)
+
+        # token -> expert-queue scatter; GSPMD lowers this to the all-to-all
+        # when x is batch-sharded and the experts axis is mesh-sharded
+        expert_in = jnp.einsum("gnec,gnd->gecd", dispatch.astype(dt), x)
+        expert_in = _constrain(expert_in, ("batch", "expert", "expert_capacity", "embed"), self.mesh)
+        gate = jnp.einsum("gecd,edm->gecm", expert_in, wg.astype(dt))
+        up = jnp.einsum("gecd,edm->gecm", expert_in, wu.astype(dt))
+        hidden = _constrain(swiglu(gate, up), ("batch", "expert", "expert_capacity", "mlp"), self.mesh)
+        expert_out = jnp.einsum("gecm,emd->gecd", hidden, wd.astype(dt))
+        expert_out = _constrain(expert_out, ("batch", "expert", "expert_capacity", "embed"), self.mesh)
+        # expert-queue -> token gather (the return all-to-all)
+        y = jnp.einsum("gnec,gecd->gnd", combine.astype(dt), expert_out)
+        return _constrain(y, ("batch", "seq", "embed"), self.mesh), aux_loss

@@ -34,6 +34,8 @@ DEFAULT_AXIS_RULES = (
     ("head_dim", None),
     ("vocab", "tensor"),
     ("expert", "expert"),
+    ("expert_capacity", None),
+    ("router_experts", None),
     ("stage", "stage"),
     ("norm", None),
 )
