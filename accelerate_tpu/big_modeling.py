@@ -95,16 +95,38 @@ class DispatchedModel:
 
     def __call__(self, *args, **kwargs):
         params = self._concrete(self.params)
+        # bools / strings / None feed Python control flow inside apply (flax's
+        # `deterministic`, mode switches) and would hit
+        # ConcretizationTypeError as tracers, so they go in static; numbers,
+        # arrays, and containers stay traced exactly as before (making
+        # containers static would silently disable jit, and making scalars
+        # static would recompile per value).
+        import enum
+
+        is_static = lambda v: isinstance(v, (bool, str, bytes, enum.Enum)) or v is None
+        traced_args = tuple(None if is_static(a) else a for a in args)
+        static_args = tuple((i, a) for i, a in enumerate(args) if is_static(a))
+        traced_kw = {k: v for k, v in kwargs.items() if not is_static(v)}
+        static_kw = tuple(sorted((k, v) for k, v in kwargs.items() if is_static(v)))
         if self._jit is None:
             shardings = self._target_shardings()
 
-            def apply(p, a, kw):
+            def apply(p, a, kw, s_args, s_kw):
+                a = list(a)
+                for i, v in s_args:
+                    a[i] = v
+                kw = dict(kw, **dict(s_kw))
                 if shardings is not None:
                     p = jax.tree_util.tree_map(jax.device_put, p, shardings)
                 return self.definition.apply({"params": p}, *a, **kw)
 
-            self._jit = jax.jit(apply)
-        return self._jit(params, args, dict(kwargs))
+            self._apply = apply
+            self._jit = jax.jit(apply, static_argnums=(3, 4))
+        try:
+            hash((static_args, static_kw))
+        except TypeError:
+            return self._apply(params, traced_args, traced_kw, static_args, static_kw)
+        return self._jit(params, traced_args, traced_kw, static_args, static_kw)
 
     def materialize(self):
         """Force all params into device memory (drops offload tiers)."""

@@ -57,6 +57,10 @@ def register(subparsers):
     parser.add_argument("--no_python", action="store_true", help="Exec script directly (not via python)")
     parser.add_argument("--quiet", "-q", action="store_true")
     parser.add_argument("--debug", action="store_true")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="Relaunch the whole world up to N times after a worker failure (elastic parity)")
+    parser.add_argument("--monitor_interval", type=float, default=0.1,
+                        help="Seconds between worker health polls")
     parser.add_argument("training_script", help="Script (or module) to launch")
     parser.add_argument("training_script_args", nargs=argparse_remainder(), help="Script args")
     parser.set_defaults(func=launch_command)
@@ -150,29 +154,39 @@ def simple_launcher(args, config: ClusterConfig) -> int:
 def multi_process_launcher(args, config: ClusterConfig) -> int:
     """Spawn num_processes local processes with the distributed env contract
     (COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID). With --cpu this is the
-    debug/gloo-on-localhost path; on a pod worker it re-enters per host."""
+    debug/gloo-on-localhost path; on a pod worker it re-enters per host.
+
+    Monitors the whole world: the first worker to exit non-zero gets the rest
+    killed (survivors would otherwise hang in collectives), and with
+    ``--max_restarts`` the world is relaunched on a fresh port — the
+    torchrun-elastic restart semantic (reference launch.py:774-806)."""
     n = config.num_processes
-    port = config.main_process_port or _free_port()
     ip = config.main_process_ip or "127.0.0.1"
     base_env = prepare_launch_env(config, args)
-    procs = []
-    for rank in range(n):
-        env = dict(base_env)
-        env[env_var("COORDINATOR_ADDRESS")] = f"{ip}:{port}"
-        env[env_var("NUM_PROCESSES")] = str(n)
-        env[env_var("PROCESS_ID")] = str(rank)
-        env[env_var("LOCAL_PROCESS_ID")] = str(rank)
-        if args.cpu:
-            _force_cpu(env)
-        procs.append(subprocess.Popen(_script_cmd(args), env=env))
-    code = 0
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
-    if code:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
+    max_restarts = getattr(args, "max_restarts", 0) or 0
+    interval = getattr(args, "monitor_interval", 0.1) or 0.1
+    for attempt in range(max_restarts + 1):
+        # fresh port each attempt: the old coordinator socket may linger
+        port = config.main_process_port if (config.main_process_port and attempt == 0) else _free_port()
+        procs = []
+        for rank in range(n):
+            env = dict(base_env)
+            env[env_var("COORDINATOR_ADDRESS")] = f"{ip}:{port}"
+            env[env_var("NUM_PROCESSES")] = str(n)
+            env[env_var("PROCESS_ID")] = str(rank)
+            env[env_var("LOCAL_PROCESS_ID")] = str(rank)
+            env[env_var("RESTART_COUNT")] = str(attempt)
+            if args.cpu:
+                _force_cpu(env)
+            procs.append(subprocess.Popen(_script_cmd(args), env=env))
+        from ..launchers import _subprocess_group_kwargs, monitor_group
+
+        code = monitor_group(procs, interval=interval, **_subprocess_group_kwargs())
+        if code == 0:
+            return 0
+        if attempt < max_restarts:
+            print(f"[accelerate-tpu launch] worker failed (exit {code}); "
+                  f"restart {attempt + 1}/{max_restarts}", file=sys.stderr)
     return code
 
 

@@ -11,6 +11,7 @@ framework works unbuilt.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import logging
 import os
 import subprocess
@@ -27,19 +28,38 @@ _LIB_TRIED = False
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc", "att_runtime.cpp")
 _OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
-_OUT = os.path.join(_OUT_DIR, "libatt_runtime.so")
 
 
 def _build() -> Optional[str]:
-    if os.path.exists(_OUT) and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC):
-        return _OUT
+    # The artifact name embeds the source hash, so a stale binary (from an
+    # older source revision) can never be picked up: it simply isn't at the
+    # expected path and a fresh build runs. _build/ is never committed.
+    try:
+        with open(_SRC, "rb") as f:
+            src_hash = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError as e:  # pragma: no cover - source missing
+        logger.warning(f"att_runtime source unreadable ({e}); using Python fallbacks")
+        return None
+    out = os.path.join(_OUT_DIR, f"libatt_runtime-{src_hash}.so")
+    if os.path.exists(out):
+        return out
     os.makedirs(_OUT_DIR, exist_ok=True)
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", _OUT]
+    # Compile to a private temp name, then rename into place: the rename is
+    # atomic, so concurrent builders (launch --num_processes N on a fresh
+    # checkout) or an interrupted g++ can never leave a half-written .so at
+    # the path other processes load.
+    tmp = f"{out}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return _OUT
+        os.replace(tmp, out)
+        return out
     except Exception as e:  # pragma: no cover - no toolchain
         logger.warning(f"att_runtime native build failed ({e}); using Python fallbacks")
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
 
 

@@ -167,6 +167,7 @@ def _load_safetensors(path: str) -> dict[str, np.ndarray]:
         from safetensors.numpy import load_file
 
         return load_file(path)
+    file_size = os.path.getsize(path)
     with open(path, "rb") as f:
         header_len = int.from_bytes(f.read(8), "little")
         header = json.loads(f.read(header_len))
@@ -178,10 +179,30 @@ def _load_safetensors(path: str) -> dict[str, np.ndarray]:
         if name == "__metadata__":
             continue
         dt = info["dtype"]
-        np_dtype = ml_dtypes.bfloat16 if dt == "BF16" else _SAFETENSORS_DTYPES[dt]
+        if dt == "BF16":
+            np_dtype = ml_dtypes.bfloat16
+        elif dt in _SAFETENSORS_DTYPES:
+            np_dtype = _SAFETENSORS_DTYPES[dt]
+        else:
+            # Unknown dtype code (e.g. F8_E4M3): let the safetensors library
+            # handle it — it validates and knows every format revision.
+            from safetensors.numpy import load_file
+
+            return load_file(path)
         arr = np.empty(tuple(info["shape"]), dtype=np_dtype)
+        begin, end = info["data_offsets"]
+        if end - begin != arr.nbytes:
+            raise ValueError(
+                f"corrupt safetensors header in {path}: tensor {name!r} spans "
+                f"{end - begin} bytes but dtype/shape imply {arr.nbytes}"
+            )
+        if begin < 0 or data_start + end > file_size:
+            raise ValueError(
+                f"corrupt safetensors header in {path}: tensor {name!r} offsets "
+                f"[{begin}, {end}) fall outside the file ({file_size} bytes)"
+            )
         names.append(name)
-        offsets.append(data_start + info["data_offsets"][0])
+        offsets.append(data_start + begin)
         dests.append(arr)
     if dests:
         parallel_read_segments(path, offsets, dests)

@@ -170,6 +170,24 @@ class TestDispatch:
         out = dispatched(ids)["logits"]
         np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
+    def test_static_bool_kwarg_feeds_python_control_flow(self):
+        import flax.linen as nn
+
+        class Gated(nn.Module):
+            @nn.compact
+            def __call__(self, x, scale_up=False):
+                w = self.param("w", nn.initializers.ones, (x.shape[-1],))
+                if scale_up:  # python control flow: must arrive static, not traced
+                    return x * w * 2
+                return x * w
+
+        model = Gated()
+        x = jnp.ones((2, 4))
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        dispatched = dispatch_model(model, params, {"": "cpu"})
+        np.testing.assert_allclose(dispatched(x, scale_up=True), 2 * np.ones((2, 4)))
+        np.testing.assert_allclose(dispatched(x, scale_up=False), np.ones((2, 4)))
+
     def test_load_checkpoint_in_model_missing_weight_errors(self, tmp_path):
         from accelerate_tpu.utils.serialization import save_pytree
 
@@ -178,3 +196,64 @@ class TestDispatch:
         save_pytree({"embedding": np.zeros((4, 4))}, str(tmp_path / "partial.safetensors"))
         with pytest.raises(ValueError, match="missing"):
             load_checkpoint_in_model(abstract, str(tmp_path / "partial.safetensors"))
+
+
+class TestSafetensorsValidation:
+    """The native loader must reject inconsistent headers instead of reading
+    adjacent tensors' bytes into the wrong weights (ADVICE r1)."""
+
+    def _write_with_header(self, path, header_dict, payload: bytes):
+        import json
+
+        header = json.dumps(header_dict).encode()
+        with open(path, "wb") as f:
+            f.write(len(header).to_bytes(8, "little"))
+            f.write(header)
+            f.write(payload)
+
+    def test_span_mismatch_raises(self, tmp_path):
+        from accelerate_tpu.utils.serialization import _load_safetensors
+
+        path = str(tmp_path / "bad.safetensors")
+        # claims shape (4,) f32 = 16 bytes but offsets span only 8
+        self._write_with_header(
+            path,
+            {"w": {"dtype": "F32", "shape": [4], "data_offsets": [0, 8]}},
+            b"\x00" * 16,
+        )
+        with pytest.raises((ValueError, Exception), match="span|corrupt|invalid"):
+            _load_safetensors(path)
+
+    def test_offsets_past_eof_raise(self, tmp_path):
+        from accelerate_tpu.utils.serialization import _load_safetensors
+
+        path = str(tmp_path / "trunc.safetensors")
+        self._write_with_header(
+            path,
+            {"w": {"dtype": "F32", "shape": [8], "data_offsets": [0, 32]}},
+            b"\x00" * 4,  # file truncated
+        )
+        with pytest.raises((ValueError, Exception), match="outside|corrupt|invalid"):
+            _load_safetensors(path)
+
+    def test_unknown_dtype_falls_back_to_library(self, tmp_path):
+        from accelerate_tpu.utils.serialization import _load_safetensors
+        from accelerate_tpu.runtime.native import native_available
+
+        if not native_available():
+            pytest.skip("native loader unavailable; fallback path is the default")
+        path = str(tmp_path / "f8.safetensors")
+        self._write_with_header(
+            path,
+            {"w": {"dtype": "F8_E4M3", "shape": [4], "data_offsets": [0, 4]}},
+            b"\x00" * 4,
+        )
+        # must not KeyError on the unknown code; the library either loads it
+        # or raises its own validated error
+        try:
+            out = _load_safetensors(path)
+            assert "w" in out
+        except KeyError:
+            pytest.fail("unknown dtype hit the native KeyError path instead of the safetensors fallback")
+        except Exception:
+            pass  # library-validated rejection is acceptable

@@ -113,3 +113,58 @@ class TestNotebookLauncher:
 
         out = notebook_launcher(lambda a, b: a + b, (1, 2))
         assert out == 3
+
+    def test_multi_process_closure_survives(self, tmp_path):
+        """Closures / interactively-defined functions must survive the spawn
+        (plain pickle serializes them by reference and fails — ADVICE r1)."""
+        from accelerate_tpu.launchers import debug_launcher
+
+        marker_dir = str(tmp_path)
+
+        def work():  # local function: unpicklable by plain pickle
+            import os
+
+            rank = os.environ["ACCELERATE_TPU_PROCESS_ID"]
+            with open(os.path.join(marker_dir, f"rank{rank}"), "w") as f:
+                f.write("ok")
+
+        debug_launcher(work, (), num_processes=2)
+        assert (tmp_path / "rank0").exists() and (tmp_path / "rank1").exists()
+
+    def test_multi_process_failure_kills_group(self, tmp_path):
+        from accelerate_tpu.launchers import debug_launcher
+
+        def work():
+            import os
+            import time
+
+            if os.environ["ACCELERATE_TPU_PROCESS_ID"] == "0":
+                raise SystemExit(3)
+            time.sleep(300)  # must be killed, not waited on
+
+        import time as _time
+
+        start = _time.monotonic()
+        with pytest.raises(RuntimeError, match="exit code 3"):
+            debug_launcher(work, (), num_processes=2)
+        assert _time.monotonic() - start < 60
+
+
+class TestElasticLaunch:
+    def test_max_restarts_recovers(self, tmp_path):
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            "import os, sys\n"
+            "if int(os.environ.get('ACCELERATE_TPU_RESTART_COUNT', '0')) < 1:\n"
+            "    sys.exit(7)\n"
+            "print('RECOVERED rank', os.environ['ACCELERATE_TPU_PROCESS_ID'])\n"
+        )
+        r = _run(["launch", "--cpu", "--num_processes", "2", "--max_restarts", "2", str(script)])
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "RECOVERED" in r.stdout
+
+    def test_restarts_exhausted_propagates_code(self, tmp_path):
+        script = tmp_path / "alwaysfail.py"
+        script.write_text("import sys; sys.exit(7)\n")
+        r = _run(["launch", "--cpu", "--num_processes", "2", "--max_restarts", "1", str(script)])
+        assert r.returncode == 7
