@@ -301,26 +301,35 @@ class TrainEngine:
         return self._jit_cache[name]
 
     def model_call(self, training: bool, *args, **kwargs):
+        # bool/str/None call-args (flax `deterministic`, BatchNorm `train`
+        # flags) feed Python control flow in the module, so they enter the
+        # jit as statics, not tracers.
+        t_args, s_args, t_kw, s_kw = _split_static_call(args, kwargs)
         if not training:
             fwd = self._get_jit(
                 "eval_fwd",
-                lambda p, es, a, kw: _cast_float_outputs(
-                    self._apply(self._cast_params(p), es, False, None, a, kw)[0],
+                lambda p, es, a, kw, sa, skw: _cast_float_outputs(
+                    self._apply(
+                        self._cast_params(p), es, False, None, *_merge_static_call(a, kw, sa, skw)
+                    )[0],
                     self.precision.output_dtype,
                 ),
-                static_argnames=(),
+                static_argnums=(4, 5),
             )
-            return fwd(self.params, self.extra_state, args, dict(kwargs))
+            return fwd(self.params, self.extra_state, t_args, t_kw, s_args, s_kw)
 
         rng_key = default_keychain().next_key("dropout")
         scale = self.scale_state["scale"] if self.scale_state is not None else None
 
         fwd_bwd = self._get_jit(
             "fwd_bwd",
-            lambda p, es, s, k, a, kw: self._fwd_bwd_fn(p, es, s, k, a, kw),
+            lambda p, es, s, k, a, kw, sa, skw: self._fwd_bwd_fn(
+                p, es, s, k, *_merge_static_call(a, kw, sa, skw)
+            ),
+            static_argnums=(6, 7),
         )
         outputs, new_state, grads, finite, loss = fwd_bwd(
-            self.params, self.extra_state, scale, rng_key, args, dict(kwargs)
+            self.params, self.extra_state, scale, rng_key, t_args, t_kw, s_args, s_kw
         )
         self.extra_state = new_state
         self._pending_grads = (grads, finite)
@@ -627,6 +636,27 @@ class TrainEngine:
             return out
 
         return apply_fn
+
+
+def _split_static_call(args, kwargs):
+    """Partition call inputs: bool/str/bytes/None/enum values become jit
+    statics (they feed Python control flow in user modules); arrays, numbers,
+    and containers stay traced."""
+    import enum
+
+    is_static = lambda v: isinstance(v, (bool, str, bytes, enum.Enum)) or v is None
+    traced_args = tuple(None if is_static(a) else a for a in args)
+    static_args = tuple((i, a) for i, a in enumerate(args) if is_static(a))
+    traced_kw = {k: v for k, v in kwargs.items() if not is_static(v)}
+    static_kw = tuple(sorted((k, v) for k, v in kwargs.items() if is_static(v)))
+    return traced_args, static_args, traced_kw, static_kw
+
+
+def _merge_static_call(args, kwargs, static_args, static_kw):
+    args = list(args)
+    for i, v in static_args:
+        args[i] = v
+    return tuple(args), dict(kwargs, **dict(static_kw))
 
 
 def _cast_float_outputs(outputs, dtype):

@@ -11,11 +11,22 @@ so we ship first-class implementations:
   (reference `examples/nlp_example.py` target, BASELINE.md).
 - ``MoeMLP`` — mixture-of-experts FFN with expert parallelism over the
   mesh "expert" axis (enabled via ``DecoderConfig.moe_num_experts``).
+- ``ResNet`` — ResNet-family image classifier
+  (reference `examples/cv_example.py` target, BASELINE.md).
 """
 
-from .configs import DecoderConfig, EncoderConfig
+from .configs import DecoderConfig, EncoderConfig, VisionConfig
 from .decoder import DecoderLM
 from .encoder import EncoderClassifier
 from .moe import MoeMLP
+from .vision import ResNet
 
-__all__ = ["DecoderConfig", "EncoderConfig", "DecoderLM", "EncoderClassifier", "MoeMLP"]
+__all__ = [
+    "DecoderConfig",
+    "EncoderConfig",
+    "VisionConfig",
+    "DecoderLM",
+    "EncoderClassifier",
+    "MoeMLP",
+    "ResNet",
+]

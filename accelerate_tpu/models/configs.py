@@ -164,3 +164,50 @@ class EncoderConfig:
     @classmethod
     def bert_base(cls, **kw):
         return cls(**kw)
+
+
+@dataclass
+class VisionConfig:
+    """ResNet-family config (reference cv_example target: ResNet-50 DP).
+
+    TPU notes: NHWC layout (XLA's native conv layout on TPU), bf16 compute
+    with fp32 BatchNorm statistics, stage widths in multiples of 128 so the
+    im2col'd matmuls tile cleanly onto the MXU.
+    """
+
+    stage_sizes: tuple = (3, 4, 6, 3)  # ResNet-50
+    num_filters: int = 64
+    num_classes: int = 1000
+    block: str = "bottleneck"  # "bottleneck" (50/101/152) or "basic" (18/34)
+    image_size: int = 224
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    stem: str = "imagenet"  # "imagenet" = 7x7/2 + maxpool; "cifar" = 3x3/1
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Test-size model (runs on the 8-device CPU sim)."""
+        kw.setdefault("stage_sizes", (1, 1))
+        kw.setdefault("num_filters", 8)
+        kw.setdefault("num_classes", 10)
+        kw.setdefault("block", "basic")
+        kw.setdefault("image_size", 32)
+        kw.setdefault("stem", "cifar")
+        kw.setdefault("dtype", jnp.float32)
+        return cls(**kw)
+
+    @classmethod
+    def resnet18(cls, **kw):
+        kw.setdefault("stage_sizes", (2, 2, 2, 2))
+        kw.setdefault("block", "basic")
+        return cls(**kw)
+
+    @classmethod
+    def resnet50(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def resnet101(cls, **kw):
+        kw.setdefault("stage_sizes", (3, 4, 23, 3))
+        return cls(**kw)

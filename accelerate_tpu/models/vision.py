@@ -1,0 +1,144 @@
+"""ResNet-family image classifier.
+
+Parity target: the model trained by the reference's canonical CV example
+(/root/reference/examples/cv_example.py — torchvision resnet50 on the pets
+dataset), whose samples/sec/chip is a BASELINE.md row. The implementation is
+TPU-first, not a torchvision translation:
+
+- NHWC layout throughout — XLA's native TPU conv layout; no transposes.
+- bf16 activations with fp32 BatchNorm statistics (TPU convs hit the MXU in
+  bf16; fp32 running stats keep eval numerics stable).
+- BatchNorm running statistics live in a mutable ``batch_stats`` collection,
+  which exercises the TrainEngine's extra-state threading (the same machinery
+  any user model with non-param state relies on).
+- ``__call__(images, labels=None)`` returns ``{"logits"[, "loss"]}`` — the
+  same output contract as the text models, so Accelerator.prepare/loss
+  selection work unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.losses import softmax_cross_entropy
+from .configs import VisionConfig
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs (ResNet-18/34)."""
+
+    filters: int
+    strides: int
+    config: VisionConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=cfg.bn_momentum,
+            epsilon=cfg.bn_eps,
+            dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = norm()(y).astype(cfg.dtype)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3))(y)
+        # zero-init the last BN scale per block: residual branches start as
+        # identity, which is what makes deep ResNets trainable from scratch
+        y = norm(scale_init=nn.initializers.zeros)(y).astype(cfg.dtype)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), strides=(self.strides, self.strides), name="proj")(residual)
+            residual = norm(name="proj_bn")(residual).astype(cfg.dtype)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce -> 3x3 -> 1x1 expand (ResNet-50/101/152), v1.5 placement:
+    the stride sits on the 3x3 conv, not the first 1x1."""
+
+    filters: int
+    strides: int
+    config: VisionConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=cfg.bn_momentum,
+            epsilon=cfg.bn_eps,
+            dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y).astype(cfg.dtype)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = norm()(y).astype(cfg.dtype)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y).astype(cfg.dtype)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), strides=(self.strides, self.strides), name="proj")(residual)
+            residual = norm(name="proj_bn")(residual).astype(cfg.dtype)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """__call__(images NHWC, labels=None) -> {"logits"[, "loss"]}."""
+
+    config: VisionConfig
+    mesh: Optional[object] = None  # accepted for API symmetry with text models
+
+    @nn.compact
+    def __call__(self, images: jax.Array, labels: Optional[jax.Array] = None, train: bool = False):
+        cfg = self.config
+        block_cls = BottleneckBlock if cfg.block == "bottleneck" else BasicBlock
+        x = images.astype(cfg.dtype)
+        if cfg.stem == "imagenet":
+            x = nn.Conv(cfg.num_filters, (7, 7), strides=(2, 2), use_bias=False, dtype=cfg.dtype, name="stem_conv")(x)
+        else:  # cifar-style stem for small images
+            x = nn.Conv(cfg.num_filters, (3, 3), use_bias=False, dtype=cfg.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=cfg.bn_momentum,
+            epsilon=cfg.bn_eps,
+            dtype=jnp.float32,
+            name="stem_bn",
+        )(x).astype(cfg.dtype)
+        x = nn.relu(x)
+        if cfg.stem == "imagenet":
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for stage, num_blocks in enumerate(cfg.stage_sizes):
+            for block in range(num_blocks):
+                strides = 2 if (stage > 0 and block == 0) else 1
+                x = block_cls(
+                    filters=cfg.num_filters * 2**stage,
+                    strides=strides,
+                    config=cfg,
+                    name=f"stage{stage}_block{block}",
+                )(x, train)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        logits = nn.Dense(cfg.num_classes, dtype=jnp.float32, name="classifier")(x.astype(jnp.float32))
+        out = {"logits": logits}
+        if labels is not None:
+            out["loss"] = softmax_cross_entropy(logits, labels)
+        return out
+
+    def init_variables(self, rng: jax.Array, batch_size: int = 1, image_size: Optional[int] = None):
+        s = image_size or self.config.image_size
+        dummy = jnp.zeros((batch_size, s, s, 3), jnp.float32)
+        return self.init(rng, dummy)

@@ -1,0 +1,120 @@
+"""By-feature example: automatic gradient accumulation.
+
+Mirrors the reference feature example
+(/root/reference/examples/by_feature/gradient_accumulation.py:160-185):
+`Accelerator(gradient_accumulation_steps=N)` plus the
+`with accelerator.accumulate(model):` context, which gates the optimizer
+step and the gradient synchronization automatically — the manual
+`if step % accumulation == 0` bookkeeping from nlp_example.py disappears.
+
+On TPU the accumulation loop is jit-fused: micro-batch gradients sum on
+device in fp32 and the implicit data-parallel psum fires once per effective
+batch, so N accumulated micro-steps cost the same HBM traffic as one big
+step. bf16 is the recommended precision (--mixed_precision bf16).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, DataLoader, Model
+from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+from accelerate_tpu.utils.random import set_seed
+
+# reuse the MRPC-shaped synthetic data + loader wiring from the base example
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+def training_function(config, args):
+    # New for this feature: the accumulation count lives on the Accelerator
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=int(args.gradient_accumulation_steps),
+    )
+    lr = config["lr"]
+    num_epochs = int(config["num_epochs"])
+    seed = int(config["seed"])
+    batch_size = int(config["batch_size"])
+
+    set_seed(seed)
+    model_config = EncoderConfig.tiny() if (args.cpu or args.tiny) else EncoderConfig.bert_base()
+    train_dataloader, eval_dataloader = get_dataloaders(
+        accelerator, batch_size, model_config,
+        train_len=config.get("train_len", 512), eval_len=config.get("eval_len", 128),
+    )
+
+    model_def = EncoderClassifier(model_config, mesh=accelerator.mesh)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(seed), batch_size=batch_size, seq_len=min(model_config.max_seq_len, 128)
+    )
+    total_steps = len(train_dataloader) * num_epochs // accelerator.gradient_accumulation_steps
+    warmup = min(100, max(total_steps // 10, 1))
+    lr_schedule = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, max(total_steps, warmup + 1))
+
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+        Model(model_def, variables), optax.adamw(lr_schedule), train_dataloader, eval_dataloader, lr_schedule
+    )
+
+    for epoch in range(num_epochs):
+        model.train()
+        for batch in train_dataloader:
+            # the accumulate() context does the step gating: grads fold into
+            # the on-device fp32 buffer every micro-step; optimizer.step()
+            # becomes a real update only when the effective batch is complete
+            with accelerator.accumulate(model):
+                outputs = model(
+                    batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
+                    labels=batch["labels"],
+                    deterministic=False,
+                )
+                accelerator.backward(outputs["loss"])
+                optimizer.step()
+                lr_scheduler.step()
+                optimizer.zero_grad()
+
+        model.eval()
+        correct = total = 0
+        for batch in eval_dataloader:
+            outputs = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+            )
+            predictions = outputs["logits"].argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics((predictions, batch["labels"]))
+            correct += int((np.asarray(predictions) == np.asarray(references)).sum())
+            total += int(np.asarray(references).shape[0])
+        accelerator.print(f"epoch {epoch}: {{'accuracy': {correct / max(total, 1):.4f}}}")
+
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Gradient-accumulation feature example.")
+    parser.add_argument(
+        "--mixed_precision", type=str, default=None, choices=["no", "fp16", "bf16"],
+        help="Whether to use mixed precision (bf16 is the TPU-native choice).",
+    )
+    parser.add_argument("--cpu", action="store_true", help="Run the tiny config on CPU.")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model/dataset (CI).")
+    parser.add_argument("--num_epochs", type=int, default=None)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=2)
+    args = parser.parse_args()
+    config = {"lr": 2e-5, "num_epochs": args.num_epochs or 3, "seed": 42, "batch_size": 16}
+    if args.tiny or args.cpu:
+        config.update({"train_len": 128, "eval_len": 64})
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Examples run end-to-end on the CPU sim + the examples-diff machinery.
+
+Parity: reference tests/test_examples.py — it (a) runs every example script,
+and (b) asserts the by_feature/complete scripts stay in sync with the base
+example outside their feature blocks (the "examples diff" machinery). Here
+(b) is structural: the feature scripts must reuse the base example's data
+pipeline (import, not copy) and keep the same eval contract.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run_example(rel_path, *extra, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, rel_path), "--cpu", "--num_epochs", "1", *extra],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO,
+    )
+
+
+@pytest.mark.slow
+class TestExamplesRun:
+    def test_nlp_example(self):
+        r = _run_example("nlp_example.py")
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout
+
+    def test_cv_example(self):
+        r = _run_example("cv_example.py")
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout
+
+    def test_gradient_accumulation_example(self):
+        r = _run_example(os.path.join("by_feature", "gradient_accumulation.py"),
+                         "--gradient_accumulation_steps", "2")
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout
+
+    def test_complete_example_checkpoints_and_resumes(self, tmp_path):
+        r = _run_example(
+            "complete_nlp_example.py",
+            "--checkpointing_steps", "epoch",
+            "--with_tracking",
+            "--project_dir", str(tmp_path),
+        )
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "epoch_0").exists(), list(tmp_path.iterdir())
+        # resume from the epoch checkpoint: must start at epoch 1 == done
+        r2 = _run_example(
+            "complete_nlp_example.py",
+            "--project_dir", str(tmp_path),
+            "--resume_from_checkpoint", str(tmp_path / "epoch_0"),
+        )
+        assert r2.returncode == 0, r2.stderr
+
+
+class TestExamplesDiff:
+    """Feature scripts must build on the base example, not fork it."""
+
+    def _src(self, rel):
+        with open(os.path.join(EXAMPLES, rel)) as f:
+            return f.read()
+
+    def test_feature_scripts_reuse_base_data_pipeline(self):
+        for rel in ("by_feature/gradient_accumulation.py", "complete_nlp_example.py"):
+            src = self._src(rel)
+            assert "from nlp_example import" in src, f"{rel} copies instead of importing"
+            assert "class ParaphraseDataset" not in src, f"{rel} duplicates the dataset"
+
+    def test_feature_scripts_keep_eval_contract(self):
+        for rel in ("nlp_example.py", "by_feature/gradient_accumulation.py", "complete_nlp_example.py"):
+            src = self._src(rel)
+            assert "gather_for_metrics" in src, rel
+
+    def test_gradient_accumulation_uses_accumulate_context(self):
+        src = self._src("by_feature/gradient_accumulation.py")
+        assert "accelerator.accumulate(" in src
+        assert "% gradient_accumulation_steps" not in src, "manual gating defeats the feature"
