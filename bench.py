@@ -1,12 +1,16 @@
-"""Training-throughput benchmark on the flagship decoder.
+"""Benchmark suite. Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", "extra": {...sub-benchmarks...}}.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Headline: training MFU on the flagship decoder (the reference publishes no
+training-throughput numbers — BASELINE.md — so the driver's north star is
+>=45% MFU and vs_baseline = MFU / 0.45). ``extra`` carries the sub-suite
+that exercises the hard paths the headline config doesn't: GQA attention,
+long-context training, and dispatch-to-first-token latency (the BASELINE
+big-model-inference analog).
 
-The reference publishes no training-throughput numbers (BASELINE.md); the
-driver's north star is >=45% MFU, so vs_baseline = MFU / 0.45. On a real
-TPU chip this trains a ~390M-param LLaMA-style model in bf16 (pallas flash
-attention, fused-CE loss, remat+scan); on CPU it falls back to a tiny model
-so the harness always produces a number.
+On a real TPU chip this trains a ~390M-param LLaMA-style model in bf16
+(pallas flash attention, fused-CE loss, remat+scan); on CPU everything falls
+back to tiny configs so the harness always produces a number.
 """
 
 from __future__ import annotations
@@ -40,32 +44,16 @@ def _peak_flops(device) -> float:
     return 200e12  # conservative default for unknown TPU; CPU runs report vs this
 
 
-def main():
+def _train_bench(cfg, batch_size, seq_len, steps, mixed_precision):
+    """Train `steps` steps, return (tokens/sec, MFU, final loss)."""
     import optax
 
     from accelerate_tpu import Accelerator, Model
-    from accelerate_tpu.models import DecoderConfig, DecoderLM
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.state import AcceleratorState
 
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
-        cfg = DecoderConfig(
-            vocab_size=32_000,
-            num_layers=12,
-            embed_dim=1536,
-            num_heads=12,
-            num_kv_heads=12,
-            mlp_dim=4096,
-            max_seq_len=2048,
-            dtype=jnp.bfloat16,
-            remat=True,
-            scan_layers=True,
-        )
-        batch_size, seq_len, steps = 8, 2048, 20
-    else:
-        cfg = DecoderConfig.tiny(max_seq_len=256)
-        batch_size, seq_len, steps = 4, 128, 5
-
-    accelerator = Accelerator(mixed_precision="bf16" if on_tpu else "no")
+    AcceleratorState._reset_state(reset_partial_state=False)
+    accelerator = Accelerator(mixed_precision=mixed_precision)
     model_def = DecoderLM(cfg, mesh=accelerator.mesh)
     variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=batch_size, seq_len=seq_len)
     model, optimizer = accelerator.prepare(
@@ -92,19 +80,101 @@ def main():
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
 
-    tokens = batch_size * seq_len * steps
-    tokens_per_sec = tokens / dt
-    n_params = cfg.num_params
+    tokens_per_sec = batch_size * seq_len * steps / dt
     # FLOPs/token: 6N weight FLOPs + causal attention 6*L*S*E
-    flops_per_token = 6 * n_params + 6 * cfg.num_layers * seq_len * cfg.embed_dim
-    achieved = tokens_per_sec * flops_per_token
-    peak = _peak_flops(jax.devices()[0])
-    mfu = achieved / peak
+    flops_per_token = 6 * cfg.num_params + 6 * cfg.num_layers * seq_len * cfg.embed_dim
+    mfu = tokens_per_sec * flops_per_token / _peak_flops(jax.devices()[0])
+    return tokens_per_sec, mfu, final_loss, dt / steps
+
+
+def _ttft_bench(cfg, prompt_len, tmpdir):
+    """Dispatch-to-first-token: checkpoint on disk -> auto device map ->
+    logits for the last prompt position (BASELINE big_model_inference rows:
+    load time + first generation step)."""
+    from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.utils.serialization import save_pytree
+
+    import os
+
+    model_def = DecoderLM(cfg)
+    variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len)
+    from accelerate_tpu.parallel.sharding import unbox_params
+
+    params, _ = unbox_params(variables["params"])
+    ckpt = os.path.join(tmpdir, "model.safetensors")
+    save_pytree(params, ckpt, max_shard_size=1 << 30)
+    del params, variables
+
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (1, prompt_len))
+    t0 = time.perf_counter()
+    dispatched = load_checkpoint_and_dispatch(
+        model_def, ckpt, jnp.zeros((1, prompt_len), jnp.int32), device_map="auto"
+    )
+    out = dispatched(jnp.asarray(ids))
+    first_logits = np.asarray(jax.device_get(out["logits"]))[:, -1]
+    ttft = time.perf_counter() - t0
+    assert np.all(np.isfinite(first_logits))
+    return ttft
+
+
+def main():
+    from accelerate_tpu.models import DecoderConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    extra = {}
+
+    if on_tpu:
+        flagship = DecoderConfig(
+            vocab_size=32_000, num_layers=12, embed_dim=1536, num_heads=12,
+            num_kv_heads=12, mlp_dim=4096, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=True, scan_layers=True,
+        )
+        tok_s, mfu, _, step_ms = _train_bench(flagship, 8, 2048, 20, "bf16")
+
+        # GQA config: 4x fewer KV heads — the kernel path the headline MHA
+        # config never exercises
+        gqa = DecoderConfig(
+            vocab_size=32_000, num_layers=12, embed_dim=1536, num_heads=12,
+            num_kv_heads=4, mlp_dim=4096, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=True, scan_layers=True,
+        )
+        gqa_tok_s, gqa_mfu, _, _ = _train_bench(gqa, 8, 2048, 10, "bf16")
+        extra["gqa_train_mfu_pct"] = round(gqa_mfu * 100, 2)
+        extra["gqa_tokens_per_sec"] = round(gqa_tok_s)
+
+        # long-context: 16k tokens single chip (ring attention exercises the
+        # sequence axis only multi-chip; single-chip this stresses the flash
+        # kernel's long-S path + remat)
+        longctx = DecoderConfig(
+            vocab_size=32_000, num_layers=8, embed_dim=1024, num_heads=8,
+            num_kv_heads=8, mlp_dim=2816, max_seq_len=16_384,
+            dtype=jnp.bfloat16, remat=True, scan_layers=True,
+        )
+        lc_tok_s, lc_mfu, _, _ = _train_bench(longctx, 1, 16_384, 5, "bf16")
+        extra["long16k_train_mfu_pct"] = round(lc_mfu * 100, 2)
+        extra["long16k_tokens_per_sec"] = round(lc_tok_s)
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            ttft_cfg = DecoderConfig(
+                vocab_size=32_000, num_layers=12, embed_dim=1536, num_heads=12,
+                num_kv_heads=12, mlp_dim=4096, max_seq_len=2048,
+                dtype=jnp.bfloat16, remat=False, scan_layers=True,
+            )
+            extra["dispatch_ttft_s"] = round(_ttft_bench(ttft_cfg, 128, td), 2)
+    else:
+        cfg = DecoderConfig.tiny(max_seq_len=256)
+        tok_s, mfu, _, step_ms = _train_bench(cfg, 4, 128, 5, "no")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            extra["dispatch_ttft_s"] = round(_ttft_bench(DecoderConfig.tiny(), 32, td), 2)
 
     print(
-        f"[bench] backend={jax.default_backend()} params={n_params/1e6:.0f}M "
-        f"tokens/s={tokens_per_sec:,.0f} step_time={dt/steps*1e3:.1f}ms "
-        f"achieved={achieved/1e12:.1f}TF/s peak={peak/1e12:.0f}TF/s",
+        f"[bench] backend={jax.default_backend()} tokens/s={tok_s:,.0f} "
+        f"step_time={step_ms * 1e3:.1f}ms extra={extra}",
         file=sys.stderr,
     )
     print(
@@ -114,6 +184,7 @@ def main():
                 "value": round(mfu * 100, 2),
                 "unit": "percent_of_peak_bf16",
                 "vs_baseline": round(mfu / 0.45, 3),
+                "extra": extra,
             }
         )
     )
