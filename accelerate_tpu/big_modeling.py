@@ -29,6 +29,7 @@ import numpy as np
 
 from .utils.modeling import (
     _DiskWeight,
+    _to_pinned_host,
     check_device_map,
     compute_module_sizes,
     get_max_memory,
@@ -37,6 +38,25 @@ from .utils.modeling import (
     placement_of,
 )
 from .utils.serialization import flatten_pytree, unflatten_to_like
+
+
+def _maybe_enable_weight_streaming(definition, device_map):
+    """If the definition supports per-layer weight streaming
+    (``config.stream_layer_weights``) and any params land off-device, turn
+    the flag on via a rebuilt definition (flax modules are frozen)."""
+    import dataclasses as _dc
+
+    cfg = getattr(definition, "config", None)
+    if cfg is None or not hasattr(cfg, "stream_layer_weights"):
+        return definition
+    tiers = set((device_map or {}).values())
+    if not (tiers - {"device"}) or cfg.stream_layer_weights:
+        return definition
+    try:
+        new_cfg = _dc.replace(cfg, stream_layer_weights=True)
+        return definition.copy(config=new_cfg) if hasattr(definition, "copy") else _dc.replace(definition, config=new_cfg)
+    except Exception:  # definition isn't a plain dataclass module
+        return definition
 
 
 def init_empty_weights(module, *sample_args, rng=None, **sample_kwargs):
@@ -61,32 +81,68 @@ class DispatchedModel:
     disk-offload semantics); host weights stream into HBM inside the jit."""
 
     def __init__(self, definition, params, mesh=None, device_map=None, output_device=None):
-        self.definition = definition
+        self.definition = _maybe_enable_weight_streaming(definition, device_map)
         self.params = params
         self.mesh = mesh
         self.device_map = dict(device_map or {})
         self._jit = None
 
-    def _target_shardings(self):
-        """Device-memory shardings for every param (where compute happens)."""
+    # sentinel "shardings" for host-tier params:
+    _STREAM = "host_stream"      # model streams this subtree itself (per-layer)
+    _TO_DEVICE = "host_to_device"  # in-graph transfer at the jit boundary
+
+    def _target_shardings(self, all_device: bool = False):
+        """Per-param placement plan.
+
+        Device-tier params get an explicit device/mesh sharding (an in-jit
+        device_put). Host-tier ("cpu"/"disk") params either stay in pinned
+        host for the model to stream per-layer inside its scan (paths the
+        definition declares via ``host_streamable_prefixes()`` — peak HBM is
+        then one layer's weights, the per-layer-streaming capability of
+        reference hooks.py:323-390), or get an in-graph host->HBM transfer
+        that XLA's latency-hiding scheduler places near the consumer."""
         from .parallel.sharding import infer_param_sharding
         from .utils.dataclasses import ShardingConfig
+        from .utils.serialization import flatten_pytree, unflatten_to_like
 
+        abstract = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype),
+            self._concrete(self.params),
+            is_leaf=lambda l: isinstance(l, _DiskWeight),
+        )
+        flat = flatten_pytree(abstract)
         if self.mesh is not None:
-            return infer_param_sharding(
-                jax.tree_util.tree_map(
-                    lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), self._concrete(self.params)
-                ),
-                self.mesh,
-                ShardingConfig(),
+            device_shardings = flatten_pytree(
+                infer_param_sharding(abstract, self.mesh, ShardingConfig())
             )
-        return None
+        else:
+            from jax.sharding import SingleDeviceSharding
+
+            sharding = SingleDeviceSharding(jax.devices()[0], memory_kind="device")
+            device_shardings = {k: sharding for k in flat}
+        streamable = []
+        fn = getattr(self.definition, "host_streamable_prefixes", None)
+        if fn is not None:
+            streamable = list(fn())
+        out = {}
+        for path in flat:
+            tier = placement_of(path, self.device_map) if self.device_map else "device"
+            if all_device or tier == "device":
+                out[path] = device_shardings[path]
+            elif any(path == p or path.startswith(p + "/") for p in streamable):
+                out[path] = self._STREAM
+            else:
+                out[path] = self._TO_DEVICE
+        return unflatten_to_like(out, abstract)
 
     @staticmethod
     def _concrete(params):
+        """Materialize _DiskWeight leaves into (pinned) host memory — not
+        HBM; the jit streams them like any other host-tier param."""
+
         def _mat(leaf):
             if isinstance(leaf, _DiskWeight):
-                return jnp.asarray(leaf.load())
+                return _to_pinned_host(leaf.load())
             return leaf
 
         return jax.tree_util.tree_map(
@@ -110,14 +166,21 @@ class DispatchedModel:
         static_kw = tuple(sorted((k, v) for k, v in kwargs.items() if is_static(v)))
         if self._jit is None:
             shardings = self._target_shardings()
+            stream = self._STREAM
+
+            def _place(leaf, sh):
+                if isinstance(sh, str):
+                    if sh == stream:
+                        return leaf  # the model streams this subtree per-layer
+                    return jax.device_put(leaf, jax.memory.Space.Device)
+                return jax.device_put(leaf, sh)
 
             def apply(p, a, kw, s_args, s_kw):
                 a = list(a)
                 for i, v in s_args:
                     a[i] = v
                 kw = dict(kw, **dict(s_kw))
-                if shardings is not None:
-                    p = jax.tree_util.tree_map(jax.device_put, p, shardings)
+                p = jax.tree_util.tree_map(_place, p, shardings)
                 return self.definition.apply({"params": p}, *a, **kw)
 
             self._apply = apply
@@ -131,10 +194,22 @@ class DispatchedModel:
     def materialize(self):
         """Force all params into device memory (drops offload tiers)."""
         params = self._concrete(self.params)
-        shardings = self._target_shardings()
-        if shardings is not None:
-            params = jax.tree_util.tree_map(jax.device_put, params, shardings)
+        shardings = self._target_shardings(all_device=True)
+        params = jax.tree_util.tree_map(jax.device_put, params, shardings)
         self.params = params
+        self.device_map = {"": "device"}
+        self._jit = None  # placements changed; retrace
+        return self
+
+    def offload(self):
+        """Demote every param back to pinned host memory (the inverse of
+        materialize; the CpuOffloadHook mechanism below relies on it)."""
+        params = self._concrete(self.params)
+        self.params = jax.tree_util.tree_map(
+            lambda p: _to_pinned_host(np.asarray(jax.device_get(p))), params
+        )
+        self.device_map = {"": "cpu"}
+        self._jit = None
         return self
 
 
@@ -187,6 +262,50 @@ def disk_offload(definition, params, offload_folder: str, mesh=None) -> Dispatch
     return dispatch_model(definition, params, {"": "disk"}, mesh=mesh, offload_folder=offload_folder)
 
 
+class CpuOffloadHook:
+    """Handle returned by cpu_offload_with_hook: lets pipelines of models
+    share HBM by explicitly demoting a model when the next one runs
+    (reference UserCpuOffloadHook, big_modeling.py:199-258)."""
+
+    def __init__(self, model: DispatchedModel, prev_hook: "CpuOffloadHook | None" = None):
+        self.model = model
+        self.prev_hook = prev_hook
+
+    def pre_forward(self):
+        if self.prev_hook is not None:
+            self.prev_hook.offload()
+        self.model.materialize()
+
+    def offload(self):
+        self.model.offload()
+
+
+class _HookedModel:
+    """Wraps a DispatchedModel so each call promotes this model's weights
+    (and demotes the previous pipeline stage's) before running."""
+
+    def __init__(self, model: DispatchedModel, hook: CpuOffloadHook):
+        self._model = model
+        self.hook = hook
+
+    def __call__(self, *args, **kwargs):
+        self.hook.pre_forward()
+        return self._model(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+
+def cpu_offload_with_hook(definition, params, mesh=None, prev_module_hook: CpuOffloadHook | None = None):
+    """Keep the model in pinned host RAM; promote it to HBM on call and give
+    the caller a hook to demote it again (reference cpu_offload_with_hook:199:
+    the pipeline pattern — running stage N+1 offloads stage N). Returns
+    ``(model, hook)``."""
+    dispatched = cpu_offload(definition, params, mesh=mesh)
+    hook = CpuOffloadHook(dispatched, prev_hook=prev_module_hook)
+    return _HookedModel(dispatched, hook), hook
+
+
 def load_checkpoint_and_dispatch(
     definition,
     checkpoint: str,
@@ -206,7 +325,9 @@ def load_checkpoint_and_dispatch(
     abstract_params = abstract["params"] if isinstance(abstract, dict) and "params" in abstract else abstract
     if isinstance(device_map, str):
         if device_map in ("auto", "balanced", "balanced_low_0", "sequential"):
-            device_map = infer_auto_device_map(abstract_params, max_memory=max_memory, dtype=dtype)
+            device_map = infer_auto_device_map(
+                abstract_params, max_memory=max_memory, dtype=dtype, mode=device_map
+            )
         else:
             device_map = {"": device_map}
     params = load_checkpoint_in_model(

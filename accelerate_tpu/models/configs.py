@@ -41,6 +41,11 @@ class DecoderConfig:
     # params + GPipe microbatch schedule (parallel/pipeline.py)
     pipeline_stages: int = 1
     pipeline_microbatches: Optional[int] = None  # None -> pipeline_stages
+    # big-model inference: keep layer weights in pinned host RAM and
+    # transfer each layer's slice to HBM inside the scan body, so peak HBM
+    # is ~one layer + embedding, not the whole model (set automatically by
+    # big_modeling.dispatch_model when layers land on the "cpu"/"disk" tier)
+    stream_layer_weights: bool = False
     # mixture-of-experts FFN over the mesh "expert" axis (models/moe.py);
     # 0 = dense MLP
     moe_num_experts: int = 0

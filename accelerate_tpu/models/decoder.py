@@ -42,6 +42,22 @@ def _dense_init(scale: float = 1.0):
     return nn.initializers.variance_scaling(scale, "fan_in", "normal")
 
 
+def _stream_params_to_device(tree):
+    """In-graph host->HBM transfer of a param subtree. Inside a scan body
+    this runs on the per-layer *slice*, so only the live layer's weights
+    occupy HBM (the per-layer-streaming capability of reference
+    hooks.py:323-390); on already-device-resident params it is a no-op."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, jax.memory.Space.Device), tree
+    )
+
+
+def _maybe_streaming(body, cfg):
+    if cfg.stream_layer_weights:
+        return nn.map_variables(body, "params", trans_in_fn=_stream_params_to_device)
+    return body
+
+
 class DecoderAttention(nn.Module):
     config: DecoderConfig
     mesh: Optional[Mesh] = None
@@ -222,7 +238,7 @@ class DecoderLM(nn.Module):
             )(x_mb, sin, cos, deterministic)
             x = merge_microbatches(x)
         elif cfg.scan_layers:
-            scan_body = _ScanBlock
+            scan_body = _maybe_streaming(_ScanBlock, cfg)
             if cfg.remat:
                 scan_body = nn.remat(
                     scan_body,
@@ -240,8 +256,9 @@ class DecoderLM(nn.Module):
                 (x, jnp.float32(0.0), sin, cos, deterministic), None
             )
         else:
+            block_cls = _maybe_streaming(DecoderBlock, cfg)
             if cfg.remat:
-                block_cls = nn.remat(DecoderBlock, prevent_cse=True)
+                block_cls = nn.remat(block_cls, prevent_cse=True)
             for i in range(cfg.num_layers):
                 x, block_aux = block_cls(cfg, self.mesh, name=f"layer_{i}")(x, sin, cos, deterministic)
                 moe_aux = moe_aux + block_aux
@@ -278,6 +295,18 @@ class DecoderLM(nn.Module):
         if cfg.moe_num_experts > 1:
             out["aux_loss"] = cfg.moe_aux_loss_weight * moe_aux / cfg.num_layers
         return out
+
+    def host_streamable_prefixes(self) -> list:
+        """Param-path prefixes this model streams host->HBM internally (the
+        dispatch layer leaves these in pinned host instead of transferring
+        them wholesale before apply). Only meaningful when
+        ``config.stream_layer_weights`` is on."""
+        cfg = self.config
+        if not cfg.stream_layer_weights or self._effective_stages() > 1:
+            return []
+        if cfg.scan_layers:
+            return ["layers"]
+        return [f"layer_{i}" for i in range(cfg.num_layers)]
 
     def _effective_stages(self) -> int:
         """Pipeline degree: explicit config wins; otherwise a mesh with a

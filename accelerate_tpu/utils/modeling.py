@@ -129,6 +129,54 @@ def _module_groups(params, split_depth: int = 1) -> list[str]:
     return groups
 
 
+def get_balanced_memory(
+    params,
+    max_memory: Optional[dict] = None,
+    dtype=None,
+    low_zero: bool = False,
+) -> dict[str, int]:
+    """Tier budgets for balanced placement (reference get_balanced_memory:1023).
+
+    The torch version caps each GPU's budget so layers spread across all
+    GPUs instead of filling gpu0. On TPU, per-chip balance of the "device"
+    tier is GSPMD's job (device-tier params shard over the mesh), so the
+    balancing that remains meaningful is *activation headroom*: reserve room
+    in HBM for the working set so dispatch doesn't pack weights wall-to-wall.
+
+    ``low_zero`` is the balanced_low_0 analog (reference :590: keep gpu0
+    nearly free for the generate loop): it halves the device budget so the
+    KV cache / decode buffers always fit.
+    """
+    budgets = get_max_memory(max_memory)
+    sizes = compute_module_sizes(params, dtype=dtype)
+    leaves = [sizes.get(g, 0) for g in _module_groups(params, split_depth=1)]
+    largest = max(leaves) if leaves else 0
+    out = dict(budgets)
+    if low_zero:
+        out["device"] = int(budgets["device"] * 0.5)
+    else:
+        out["device"] = int(budgets["device"]) - largest // 2
+    return out
+
+
+def _child_groups(all_paths: list[str], prefix: str) -> list[str]:
+    """Next-depth prefixes strictly under ``prefix`` (split-on-overflow
+    units, reference infer_auto_device_map:1261-1337)."""
+    depth = len(prefix.split("/")) if prefix else 0
+    children, seen = [], set()
+    for path in all_paths:
+        if prefix and not (path == prefix or path.startswith(prefix + "/")):
+            continue
+        parts = path.split("/")
+        if len(parts) <= depth:
+            continue
+        child = "/".join(parts[: depth + 1])
+        if child not in seen:
+            seen.add(child)
+            children.append(child)
+    return children
+
+
 def infer_auto_device_map(
     params,
     max_memory: Optional[dict] = None,
@@ -136,35 +184,95 @@ def infer_auto_device_map(
     dtype=None,
     split_depth: int = 1,
     reserve_largest: bool = True,
+    mode: str = "auto",
 ) -> dict[str, str]:
-    """Greedy first-fit of module groups into device -> cpu -> disk
-    (reference infer_auto_device_map:1168). Tied groups co-locate with
-    their first occurrence (reference :1340+)."""
-    budgets = get_max_memory(max_memory)
+    """Fit module groups into device -> cpu -> disk in module order
+    (reference infer_auto_device_map:1168).
+
+    - The tier pointer only advances (reference's current_device): once a
+      group spills to "cpu", later groups never jump back to "device" —
+      placement follows execution order, which is what lets offloaded
+      execution stream tiers sequentially.
+    - A group that overflows the current tier is split into its child
+      prefixes and re-fit (reference :1261-1337), down to single params.
+    - Tied params co-locate with their first-placed partner at zero extra
+      cost (reference :1340+).
+    - ``mode``: "auto"/"balanced" reserve activation headroom on device;
+      "balanced_low_0" halves the device budget (generate-loop headroom);
+      "sequential" uses the raw budgets (fill HBM completely, then spill).
+    """
+    if mode in ("auto", "balanced"):
+        budgets = get_balanced_memory(params, max_memory, dtype=dtype) if reserve_largest else get_max_memory(max_memory)
+    elif mode == "balanced_low_0":
+        budgets = get_balanced_memory(params, max_memory, dtype=dtype, low_zero=True)
+    elif mode == "sequential":
+        budgets = get_max_memory(max_memory)
+    else:
+        raise ValueError(f"unknown device-map mode {mode!r}")
+
+    flat = flatten_pytree(params)
+    all_paths = list(flat)
     sizes = compute_module_sizes(params, dtype=dtype)
-    groups = _module_groups(params, split_depth)
-    group_sizes = {g: sizes.get(g, 0) for g in groups}
+
+    # tied-param co-location: every tied leaf points at its group leader
+    tie_leader: dict[str, str] = {}
+    for group in find_tied_parameters(params):
+        for path in group[1:]:
+            tie_leader[path] = group[0]
+
+    def _leaves_of(prefix: str) -> list[str]:
+        return [p for p in all_paths if p == prefix or p.startswith(prefix + "/")]
 
     device_map: dict[str, str] = {}
+    placed_leaves: dict[str, str] = {}  # leaf path -> tier
     remaining = {k: int(v) for k, v in budgets.items()}
-    if reserve_largest and groups:
-        # keep room on-device for the largest group's activations
-        remaining["device"] -= max(group_sizes.values()) // 2
-
     tiers = [t for t in ("device", "cpu", "disk") if t in remaining]
-    for group in groups:
+
+    from collections import deque
+
+    worklist = deque(_module_groups(params, split_depth))
+    cur = 0
+    while worklist:
+        group = worklist.popleft()
+        leaves = _leaves_of(group)
+        # bytes this group actually adds: tied leaves whose leader is placed
+        # ride along for free
+        free_riders = [p for p in leaves if tie_leader.get(p) in placed_leaves]
+        size = sizes.get(group, 0) - sum(sizes.get(p, 0) for p in free_riders)
+        if size <= 0 and free_riders:
+            tier = placed_leaves[tie_leader[free_riders[0]]]
+            device_map[group] = tier
+            for p in leaves:
+                placed_leaves[p] = tier
+            continue
         placed = False
-        for tier in tiers:
-            if group_sizes[group] <= remaining[tier]:
+        while cur < len(tiers):
+            tier = tiers[cur]
+            if size <= remaining[tier]:
                 device_map[group] = tier
-                remaining[tier] -= group_sizes[group]
+                remaining[tier] -= size
+                for p in leaves:
+                    placed_leaves[p] = tier
                 placed = True
                 break
+            children = _child_groups(all_paths, group)
+            if len(children) > 1 and remaining[tier] > 0:
+                # split on overflow: the front children may still fit here
+                worklist.extendleft(reversed(children))
+                placed = True
+                break
+            cur += 1  # this tier is exhausted for module-order placement
         if not placed:
             raise ValueError(
-                f"module group {group!r} ({group_sizes[group]} bytes) does not fit "
+                f"module group {group!r} ({size} bytes) does not fit "
                 f"any memory tier {remaining}"
             )
+    # tied leaves placed on a different tier than their leader ride with the
+    # leader: record the explicit leaf entry (longest prefix wins in
+    # placement_of)
+    for path, leader in tie_leader.items():
+        if leader in placed_leaves and placed_leaves.get(path) != placed_leaves[leader]:
+            device_map[path] = placed_leaves[leader]
     return device_map
 
 
@@ -259,11 +367,15 @@ def load_checkpoint_in_model(
 def _to_pinned_host(value: np.ndarray):
     """Place an array in pinned host memory (falls back to device default
     when the backend lacks the memory kind)."""
+    from jax.sharding import SingleDeviceSharding
+
     dev = jax.local_devices()[0]
     try:
-        mem = [m for m in dev.addressable_memories() if m.kind == "pinned_host"]
-        if mem:
-            return jax.device_put(jnp.asarray(value), mem[0])
+        if any(m.kind == "pinned_host" for m in dev.addressable_memories()):
+            sharding = SingleDeviceSharding(dev, memory_kind="pinned_host")
+            out = jax.device_put(jnp.asarray(value), sharding)
+            assert out.sharding.memory_kind == "pinned_host"
+            return out
     except Exception:  # pragma: no cover
         pass
     return jnp.asarray(value)

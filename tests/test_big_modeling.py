@@ -110,6 +110,70 @@ class TestModelingUtils:
         mm = get_max_memory()
         assert mm["device"] > 0 and mm["cpu"] > 0 and mm["disk"] > mm["cpu"]
 
+    def test_get_balanced_memory_reserves_headroom(self):
+        from accelerate_tpu.utils.modeling import get_balanced_memory
+
+        params = {"a": np.zeros((1000,), np.float32), "b": np.zeros((10,), np.float32)}
+        raw = get_max_memory({"device": 100_000, "cpu": 100_000, "disk": 1 << 62})
+        balanced = get_balanced_memory(params, raw)
+        assert balanced["device"] == 100_000 - 2000  # largest group / 2
+        low0 = get_balanced_memory(params, raw, low_zero=True)
+        assert low0["device"] == 50_000
+
+    def test_split_on_overflow_splits_group_across_tiers(self):
+        # one big module whose children individually fit the device budget
+        params = {
+            "block": {f"w{i}": np.zeros((100,), np.float32) for i in range(4)},  # 4x400B
+        }
+        dm = infer_auto_device_map(
+            params, max_memory={"device": 900, "cpu": 1 << 30}, mode="sequential"
+        )
+        tiers = {placement_of(f"block/w{i}", dm) for i in range(4)}
+        assert tiers == {"device", "cpu"}, dm
+        on_device = [i for i in range(4) if placement_of(f"block/w{i}", dm) == "device"]
+        assert len(on_device) == 2, dm  # 2x400 fits in 900, the rest spilled
+
+    def test_tier_pointer_never_goes_back(self):
+        # after a big module spills to cpu, a later small one must not jump
+        # back to device (placement follows execution order)
+        params = {
+            "a_first": np.zeros((200,), np.float32),   # 800B -> device
+            "b_big": np.zeros((300,), np.float32),     # 1200B -> spills
+            "c_small": np.zeros((10,), np.float32),    # must follow to cpu
+        }
+        dm = infer_auto_device_map(
+            params, max_memory={"device": 1000, "cpu": 1 << 30}, mode="sequential"
+        )
+        assert dm["a_first"] == "device"
+        assert dm["b_big"] == "cpu"
+        assert dm["c_small"] == "cpu"
+
+    def test_tied_params_colocate_for_free(self):
+        w = np.zeros((100,), np.float32)  # 400B, tied in two modules
+        params = {
+            "emb": {"w": w},
+            "filler": np.zeros((50,), np.float32),
+            "head": {"w": w},
+        }
+        # budget fits emb + filler but NOT an untied second copy of w
+        dm = infer_auto_device_map(
+            params, max_memory={"device": 700, "cpu": 1 << 30}, mode="sequential"
+        )
+        assert placement_of("emb/w", dm) == "device"
+        assert placement_of("head/w", dm) == "device", dm  # rides along free
+
+    def test_device_map_modes(self):
+        model, _ = _tiny_model()
+        abstract = init_empty_weights(model, jnp.zeros((1, 8), jnp.int32))["params"]
+        total = compute_module_sizes(abstract)[""]
+        budget = {"device": total * 2, "cpu": total * 2, "disk": 1 << 62}
+        seq = infer_auto_device_map(abstract, max_memory=budget, mode="sequential")
+        assert set(seq.values()) == {"device"}
+        low0 = infer_auto_device_map(abstract, max_memory=budget, mode="balanced_low_0")
+        assert "device" in set(low0.values())
+        with pytest.raises(ValueError, match="unknown device-map mode"):
+            infer_auto_device_map(abstract, max_memory=budget, mode="bogus")
+
     def test_placement_longest_prefix_wins(self):
         dm = {"": "device", "layers": "cpu", "layers/block/attn": "disk"}
         assert placement_of("embedding", dm) == "device"
@@ -169,6 +233,50 @@ class TestDispatch:
         )
         out = dispatched(ids)["logits"]
         np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_cpu_offload_enables_per_layer_streaming(self):
+        """A scanned decoder dispatched off-device must (a) flip
+        stream_layer_weights on, (b) declare its layer stack streamable so
+        the stack is NOT transferred wholesale, (c) still match dense."""
+        from accelerate_tpu.models import DecoderConfig, DecoderLM
+
+        cfg = DecoderConfig.tiny(scan_layers=True)
+        model = DecoderLM(cfg)
+        variables = model.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+        from accelerate_tpu.parallel.sharding import unbox_params
+
+        params, _ = unbox_params(variables["params"])
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (1, 16)))
+        ref = model.apply({"params": params}, ids)["logits"]
+
+        dispatched = cpu_offload(model, params)
+        assert dispatched.definition.config.stream_layer_weights
+        assert dispatched.definition.host_streamable_prefixes() == ["layers"]
+        plan = dispatched._target_shardings()
+        from accelerate_tpu.utils.serialization import flatten_pytree
+
+        flat_plan = flatten_pytree(plan)
+        layer_entries = {k: v for k, v in flat_plan.items() if k.startswith("layers/")}
+        assert layer_entries and all(v == "host_stream" for v in layer_entries.values())
+        out = dispatched(ids)["logits"]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+    def test_cpu_offload_with_hook_pipelines_hbm(self):
+        from accelerate_tpu.big_modeling import cpu_offload_with_hook
+
+        model, cfg = _tiny_model()
+        params, ids, ref = self._params_and_batch(model, cfg)
+        m1, hook1 = cpu_offload_with_hook(model, params)
+        m2, hook2 = cpu_offload_with_hook(model, params, prev_module_hook=hook1)
+        out1 = m1(ids)["logits"]
+        np.testing.assert_allclose(out1, ref, atol=1e-5, rtol=1e-5)
+        assert m1.device_map == {"": "device"}  # promoted by its own call
+        out2 = m2(ids)["logits"]
+        np.testing.assert_allclose(out2, ref, atol=1e-5, rtol=1e-5)
+        assert m1.device_map == {"": "cpu"}  # demoted when stage 2 ran
+        assert m2.device_map == {"": "device"}
+        hook2.offload()
+        assert m2.device_map == {"": "cpu"}
 
     def test_static_bool_kwarg_feeds_python_control_flow(self):
         import flax.linen as nn
