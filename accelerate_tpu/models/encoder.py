@@ -37,7 +37,7 @@ class EncoderBlock(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, x, bias, deterministic: bool = True):
+    def __call__(self, x, kv_mask, deterministic: bool = True):
         cfg = self.config
         e, h = cfg.embed_dim, cfg.num_heads
         d = e // h
@@ -54,7 +54,8 @@ class EncoderBlock(nn.Module):
         q = jnp.einsum("bse,ehd->bhsd", x, wq.astype(dt))
         k = jnp.einsum("bse,ehd->bhsd", x, wk.astype(dt))
         v = jnp.einsum("bse,ehd->bhsd", x, wv.astype(dt))
-        attn = dot_product_attention(q, k, v, causal=False, bias=bias)
+        # padding as kv_mask keeps padded batches on the flash-kernel path
+        attn = dot_product_attention(q, k, v, causal=False, kv_mask=kv_mask)
         attn = jnp.einsum("bhsd,hde->bse", attn, wo.astype(dt))
         if cfg.dropout_rate > 0.0:
             attn = nn.Dropout(cfg.dropout_rate)(attn, deterministic=deterministic)
@@ -122,15 +123,15 @@ class EncoderClassifier(nn.Module):
         if cfg.dropout_rate > 0.0:
             x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
 
-        bias = None
+        kv_mask = None
         if attention_mask is not None:
-            bias = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+            kv_mask = attention_mask.astype(jnp.int32)
 
         body = EncoderBlock
         if cfg.remat:
             body = nn.remat(EncoderBlock, prevent_cse=True)
         for i in range(cfg.num_layers):
-            x = body(cfg, self.mesh, name=f"layer_{i}")(x, bias, deterministic)
+            x = body(cfg, self.mesh, name=f"layer_{i}")(x, kv_mask, deterministic)
 
         # BERT pooler: tanh(dense(CLS))
         wp = self.param("pooler_kernel", nn.with_logical_partitioning(_dense_init(), ("embed", "embed")), (cfg.embed_dim, cfg.embed_dim))

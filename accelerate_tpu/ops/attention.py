@@ -14,8 +14,13 @@ kernel as its per-shard inner step.
 
 Capabilities:
 - causal or full attention, fp32 accumulation, bf16 in/out
-- GQA/MQA (kv heads broadcast over query-head groups)
+- GQA/MQA native: kv blocks are indexed per query-head group in the
+  BlockSpec (`h // group`), so K/V are never expanded to full head count
+  and the dk/dv pass sums the group's gradients in-kernel
+- padding masks (`kv_mask`) and packed-sequence `segment_ids`, applied
+  inside the kernels (padded/packed workloads stay on the flash path)
 - custom VJP: pallas forward AND backward (dq and dk/dv kernels)
+- `(out, lse)` residual export for the ring-attention inner step
 - `interpret=True` runs the same kernels on CPU for tests
 """
 
@@ -87,11 +92,58 @@ def mha_reference(
 
 
 # ---------------------------------------------------------------------------
-# pallas kernels (MHA core; GQA handled by the public wrapper)
+# pallas kernels
+#
+# All kernels take the optional mask refs (kv_mask [B, Skv] int32 — nonzero
+# = attend; q_seg/kv_seg [B, S] int32 — attend iff equal) threaded by
+# compile-time has_* flags, and handle GQA by kv-head block indexing.
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, sm_scale, causal, bq, bk, nk):
+def _parse_refs(args, n_out, has_kv_mask, has_seg):
+    """Split pallas's positional (in_refs..., out_refs..., scratch...) by
+    the kernel's compile-time mask flags."""
+    i = 3
+    kv_mask_ref = q_seg_ref = kv_seg_ref = None
+    if has_kv_mask:
+        kv_mask_ref = args[i]
+        i += 1
+    if has_seg:
+        q_seg_ref, kv_seg_ref = args[i], args[i + 1]
+        i += 2
+    outs = args[i : i + n_out]
+    scratch = args[i + n_out :]
+    return args[0], args[1], args[2], kv_mask_ref, q_seg_ref, kv_seg_ref, outs, scratch
+
+
+def _mask_block(s, kv_mask_ref, q_seg_ref, kv_seg_ref, causal, iq, ik, bq, bk):
+    """Apply causal / padding / segment masks to a [bq, bk] score block.
+    Returns (masked scores, bool validity matrix or None)."""
+    valid = None
+    if causal:
+        rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = cols <= rows
+    if kv_mask_ref is not None:
+        kvm = kv_mask_ref[0, 0] != 0  # [bk] (mask blocks are [1, 1, bk])
+        m = jnp.broadcast_to(kvm[None, :], (bq, bk))
+        valid = m if valid is None else (valid & m)
+    if q_seg_ref is not None:
+        qs = q_seg_ref[0, 0]  # [bq]
+        ks = kv_seg_ref[0, 0]  # [bk]
+        m = qs[:, None] == ks[None, :]
+        valid = m if valid is None else (valid & m)
+    if valid is not None:
+        s = jnp.where(valid, s, NEG_INF)
+    return s, valid
+
+
+def _fwd_kernel(*args, sm_scale, causal, bq, bk, nk, has_kv_mask, has_seg):
+    q_ref, k_ref, v_ref, kv_mask_ref, q_seg_ref, kv_seg_ref, outs, scratch = _parse_refs(
+        args, 2, has_kv_mask, has_seg
+    )
+    o_ref, lse_ref = outs
+    acc, m_scr, l_scr = scratch
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -112,10 +164,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, sm_sc
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         s = s * sm_scale
-        if causal:
-            rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols <= rows, s, NEG_INF)
+        s, _ = _mask_block(s, kv_mask_ref, q_seg_ref, kv_seg_ref, causal, iq, ik, bq, bk)
         m_prev = m_scr[...][:, :1]
         l_prev = l_scr[...][:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -136,11 +185,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, sm_sc
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc[...] / safe_l).astype(o_ref.dtype)
         # TPU tiling: lse lives as [B, H, 8, Sq] (one f32 sublane tile);
-        # row 0 is the value, rows 1-7 are padding.
-        lse_ref[0, 0] = jnp.broadcast_to((m + jnp.log(safe_l))[:, 0][None, :], lse_ref.shape[2:])
+        # row 0 is the value, rows 1-7 are padding. Fully-masked rows keep
+        # lse = NEG_INF (l == 0) so downstream merges treat them as empty.
+        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(safe_l))
+        lse_ref[0, 0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[2:])
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, sm_scale, causal, bq, bk, nk):
+def _p_from_lse(s, lse, valid):
+    """exp(s - lse) with masked entries forced to exactly 0 (a fully masked
+    row has lse = NEG_INF, where s - lse would be 0 -> p 1 -> garbage)."""
+    p = jnp.exp(s - lse)
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
+    return p
+
+
+def _dq_kernel(*args, sm_scale, causal, bq, bk, nk, has_kv_mask, has_seg):
+    # in_refs: q, k, v, do, lse, delta, [kv_mask], [q_seg, kv_seg]
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = args[:6]
+    i = 6
+    kv_mask_ref = q_seg_ref = kv_seg_ref = None
+    if has_kv_mask:
+        kv_mask_ref = args[i]
+        i += 1
+    if has_seg:
+        q_seg_ref, kv_seg_ref = args[i], args[i + 1]
+        i += 2
+    dq_ref = args[i]
+    dq_acc = args[i + 1]
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -158,11 +230,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, 
         lse = lse_ref[0, 0, 0][:, None]
         delta = delta_ref[0, 0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols <= rows, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        s, valid = _mask_block(s, kv_mask_ref, q_seg_ref, kv_seg_ref, causal, iq, ik, bq, bk)
+        p = _p_from_lse(s, lse, valid)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dq_acc[...] += jax.lax.dot_general(
@@ -174,15 +243,31 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, 
         dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal, bq, bk, nq):
-    ik, iq = pl.program_id(2), pl.program_id(3)
+def _dkv_kernel(*args, sm_scale, causal, bq, bk, nq_total, nq, has_kv_mask, has_seg):
+    """dk/dv for one kv head. Grid dim 3 runs over nq_total = nq * group
+    query blocks (all blocks of every query head in this kv head's group),
+    so the group's gradients sum into the kv head in-kernel — GQA without
+    expanding K/V."""
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = args[:6]
+    i = 6
+    kv_mask_ref = q_seg_ref = kv_seg_ref = None
+    if has_kv_mask:
+        kv_mask_ref = args[i]
+        i += 1
+    if has_seg:
+        q_seg_ref, kv_seg_ref = args[i], args[i + 1]
+        i += 2
+    dk_ref, dv_ref = args[i], args[i + 1]
+    dk_acc, dv_acc = args[i + 2], args[i + 3]
+    ik, it = pl.program_id(2), pl.program_id(3)
+    iq = it % nq  # query-block index within the current group member
 
-    @pl.when(iq == 0)
+    @pl.when(it == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    run = (iq + 1) * bq > ik * bk if causal else iq >= 0
+    run = (iq + 1) * bq > ik * bk if causal else it >= 0
 
     @pl.when(run)
     def _body():
@@ -193,11 +278,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         lse = lse_ref[0, 0, 0][:, None]
         delta = delta_ref[0, 0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols <= rows, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        s, valid = _mask_block(s, kv_mask_ref, q_seg_ref, kv_seg_ref, causal, iq, ik, bq, bk)
+        p = _p_from_lse(s, lse, valid)  # [bq, bk]
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -207,7 +289,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(iq == nq - 1)
+    @pl.when(it == nq_total - 1)
     def _out():
         dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
@@ -234,20 +316,49 @@ def _grid_params(interpret: bool):
     return kw
 
 
-def _flash_fwd_call(q, k, v, causal, sm_scale, bq, bk, interpret):
+def _mask_specs(masks, bq, bk, group):
+    """(in_specs, arrays) for the optional kv_mask / segment-id inputs.
+    kv-indexed arrays block over ik; q-indexed over iq. Masks carry an
+    explicit singleton sublane dim ([B, 1, S], block (1, 1, blk)) to satisfy
+    the TPU (8, 128) block-tiling rule."""
+    kv_mask, q_seg, kv_seg = masks
+    specs, arrays = [], []
+    if kv_mask is not None:
+        specs.append(pl.BlockSpec((1, 1, bk), lambda b_, h_, iq, ik: (b_, 0, ik)))
+        arrays.append(kv_mask.astype(jnp.int32)[:, None, :])
+    if q_seg is not None:
+        specs.append(pl.BlockSpec((1, 1, bq), lambda b_, h_, iq, ik: (b_, 0, iq)))
+        arrays.append(q_seg.astype(jnp.int32)[:, None, :])
+        specs.append(pl.BlockSpec((1, 1, bk), lambda b_, h_, iq, ik: (b_, 0, ik)))
+        arrays.append(kv_seg.astype(jnp.int32)[:, None, :])
+    return specs, arrays
+
+
+def _flash_fwd_call(q, k, v, masks, causal, sm_scale, bq, bk, interpret):
     b, h, sq, d = q.shape
-    skv = k.shape[2]
+    kvh, skv = k.shape[1], k.shape[2]
+    group = h // kvh
     nq, nk = sq // bq, skv // bk
+    kv_mask, q_seg, kv_seg = masks
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk
+        _fwd_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        bq=bq,
+        bk=bk,
+        nk=nk,
+        has_kv_mask=kv_mask is not None,
+        has_seg=q_seg is not None,
     )
+    mask_specs, mask_arrays = _mask_specs(masks, bq, bk, group)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_ // group, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_ // group, ik, 0)),
+            *mask_specs,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
@@ -259,49 +370,77 @@ def _flash_fwd_call(q, k, v, causal, sm_scale, bq, bk, interpret):
         ],
         scratch_shapes=[_vmem((bq, d)), _vmem((bq, 128)), _vmem((bq, 128))],
         **_grid_params(interpret),
-    )(q, k, v)
+    )(q, k, v, *mask_arrays)
     return out, lse
 
 
-def _flash_bwd_call(q, k, v, out, lse, do, causal, sm_scale, bq, bk, interpret):
+def _flash_bwd_call(q, k, v, out, lse, do, masks, causal, sm_scale, bq, bk, interpret):
     b, h, sq, d = q.shape
-    skv = k.shape[2]
+    kvh, skv = k.shape[1], k.shape[2]
+    group = h // kvh
     nq, nk = sq // bq, skv // bk
+    kv_mask, q_seg, kv_seg = masks
+    has_kv_mask, has_seg = kv_mask is not None, q_seg is not None
     lse = jnp.broadcast_to(lse, (b, h, 8, sq))  # residual stored [B,H,1,Sq]
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,Sq]
     delta = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, sq))  # sublane-tile layout
 
+    mask_specs, mask_arrays = _mask_specs(masks, bq, bk, group)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk),
+        functools.partial(
+            _dq_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk,
+            has_kv_mask=has_kv_mask, has_seg=has_seg,
+        ),
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_ // group, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_ // group, ik, 0)),
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, 8, bq), lambda b_, h_, iq, ik: (b_, h_, 0, iq)),
             pl.BlockSpec((1, 1, 8, bq), lambda b_, h_, iq, ik: (b_, h_, 0, iq)),
+            *mask_specs,
         ],
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[_vmem((bq, d))],
         **_grid_params(interpret),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *mask_arrays)
+
+    # dk/dv: grid over kv heads; innermost dim covers every (group member,
+    # query block) pair so the group's grads accumulate into one kv block
+    nq_total = nq * group
+
+    def _qh(kv_, it):  # query head for this grid step
+        return kv_ * group + it // nq
+
+    _, _ = _mask_specs(masks, bq, bk, group)  # arrays reused from fwd layout
+    # q-indexed mask specs need the (kv_, it) index layout of this grid
+    mask_specs_kv = []
+    if has_kv_mask:
+        mask_specs_kv.append(pl.BlockSpec((1, 1, bk), lambda b_, kv_, ik, it: (b_, 0, ik)))
+    if has_seg:
+        mask_specs_kv.append(pl.BlockSpec((1, 1, bq), lambda b_, kv_, ik, it: (b_, 0, it % nq)))
+        mask_specs_kv.append(pl.BlockSpec((1, 1, bk), lambda b_, kv_, ik, it: (b_, 0, ik)))
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nq=nq),
-        grid=(b, h, nk, nq),
+        functools.partial(
+            _dkv_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk,
+            nq_total=nq_total, nq=nq, has_kv_mask=has_kv_mask, has_seg=has_seg,
+        ),
+        grid=(b, kvh, nk, nq_total),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, 8, bq), lambda b_, h_, ik, iq: (b_, h_, 0, iq)),
-            pl.BlockSpec((1, 1, 8, bq), lambda b_, h_, ik, iq: (b_, h_, 0, iq)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, kv_, ik, it: (b_, _qh(kv_, it), it % nq, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, kv_, ik, it: (b_, kv_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, kv_, ik, it: (b_, kv_, ik, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, kv_, ik, it: (b_, _qh(kv_, it), it % nq, 0)),
+            pl.BlockSpec((1, 1, 8, bq), lambda b_, kv_, ik, it: (b_, _qh(kv_, it), 0, it % nq)),
+            pl.BlockSpec((1, 1, 8, bq), lambda b_, kv_, ik, it: (b_, _qh(kv_, it), 0, it % nq)),
+            *mask_specs_kv,
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, kv_, ik, it: (b_, kv_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, kv_, ik, it: (b_, kv_, ik, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -309,7 +448,7 @@ def _flash_bwd_call(q, k, v, out, lse, do, causal, sm_scale, bq, bk, interpret):
         ],
         scratch_shapes=[_vmem((bk, d)), _vmem((bk, d))],
         **_grid_params(interpret),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *mask_arrays)
     return dq, dk, dv
 
 
@@ -320,29 +459,31 @@ def _vmem(shape):
 
 
 # ---------------------------------------------------------------------------
-# custom-VJP core (MHA; q/k/v all [B, H, S, D] with equal H)
+# custom-VJP core. q [B, H, Sq, D]; k/v [B, KVH, Skv, D] (KVH divides H).
+# ``masks`` is a tuple (kv_mask | None, q_seg | None, kv_seg | None) — int
+# arrays are non-differentiable, their cotangent is None.
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_mha(q, k, v, causal, sm_scale, bq, bk, interpret):
-    out, _ = _flash_fwd_call(q, k, v, causal, sm_scale, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_core(q, k, v, masks, causal, sm_scale, bq, bk, interpret):
+    out, _ = _flash_fwd_call(q, k, v, masks, causal, sm_scale, bq, bk, interpret)
     return out
 
 
-def _flash_mha_fwd(q, k, v, causal, sm_scale, bq, bk, interpret):
-    out, lse = _flash_fwd_call(q, k, v, causal, sm_scale, bq, bk, interpret)
+def _flash_core_fwd(q, k, v, masks, causal, sm_scale, bq, bk, interpret):
+    out, lse = _flash_fwd_call(q, k, v, masks, causal, sm_scale, bq, bk, interpret)
     # keep only the value row of the [B,H,8,Sq] tile layout as the residual
-    return out, (q, k, v, out, lse[:, :, :1])
+    return out, (q, k, v, masks, out, lse[:, :, :1])
 
 
-def _flash_mha_bwd(causal, sm_scale, bq, bk, interpret, res, do):
-    q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd_call(q, k, v, out, lse, do, causal, sm_scale, bq, bk, interpret)
-    return dq, dk, dv
+def _flash_core_bwd(causal, sm_scale, bq, bk, interpret, res, do):
+    q, k, v, masks, out, lse = res
+    dq, dk, dv = _flash_bwd_call(q, k, v, out, lse, do, masks, causal, sm_scale, bq, bk, interpret)
+    return dq, dk, dv, None
 
 
-_flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(
@@ -352,20 +493,26 @@ def flash_attention(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
     block_q: int = 512,
     block_kv: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     """Pallas flash attention. q: [B, H, Sq, D]; k/v: [B, KVH, Skv, D]
-    (KVH must divide H; kv heads are broadcast across the query group, and
-    their gradients sum back automatically through the broadcast)."""
+    (KVH must divide H — kv blocks are shared across the query-head group in
+    the kernel; K/V are never expanded).
+
+    ``kv_mask`` [B, Skv]: nonzero = position may be attended (padding mask).
+    ``q_segment_ids``/``kv_segment_ids`` [B, S]: tokens attend only within
+    equal segment ids (packed sequences)."""
     sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     h, kvh = q.shape[1], k.shape[1]
-    if kvh != h:
-        if h % kvh:
-            raise ValueError(f"query heads ({h}) must be a multiple of kv heads ({kvh})")
-        k = jnp.repeat(k, h // kvh, axis=1)
-        v = jnp.repeat(v, h // kvh, axis=1)
+    if h % kvh:
+        raise ValueError(f"query heads ({h}) must be a multiple of kv heads ({kvh})")
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("q_segment_ids and kv_segment_ids must be given together")
     bq = _pick_block(q.shape[2], block_q)
     bk = _pick_block(k.shape[2], block_kv)
     if not bq or not bk:
@@ -373,7 +520,57 @@ def flash_attention(
             f"sequence lengths ({q.shape[2]}, {k.shape[2]}) need a 128-multiple block; "
             "pad inputs or use dot_product_attention (auto-fallback)"
         )
-    return _flash_mha(q, k, v, causal, sm_scale, bq, bk, interpret)
+    masks = (kv_mask, q_segment_ids, kv_segment_ids)
+    return _flash_core(q, k, v, masks, causal, sm_scale, bq, bk, interpret)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,
+    block_q: int = 512,
+    block_kv: int = 512,
+    interpret: bool = False,
+):
+    """Forward-only flash attention returning (out, lse [B, H, Sq] fp32).
+    The ring-attention inner step (parallel/context.py) builds its own
+    ring-level VJP from this plus the dq/dkv kernels below."""
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    bq = _pick_block(q.shape[2], block_q)
+    bk = _pick_block(k.shape[2], block_kv)
+    if not bq or not bk:
+        raise ValueError("sequence lengths need a 128-multiple block")
+    masks = (kv_mask, None, None)
+    out, lse = _flash_fwd_call(q, k, v, masks, causal, sm_scale, bq, bk, interpret)
+    return out, lse[:, :, 0]
+
+
+def flash_attention_bwd(
+    q, k, v, out, lse, do, *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,
+    block_q: int = 512,
+    block_kv: int = 512,
+    interpret: bool = False,
+):
+    """Block gradients given a (possibly global) lse [B, H, Sq]: returns
+    (dq, dk, dv) for this q/kv block pair. With p = exp(s - lse), partial
+    contributions sum correctly across kv blocks — which is exactly what the
+    ring backward needs."""
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    bq = _pick_block(q.shape[2], block_q)
+    bk = _pick_block(k.shape[2], block_kv)
+    if not bq or not bk:
+        raise ValueError("sequence lengths need a 128-multiple block")
+    masks = (kv_mask, None, None)
+    return _flash_bwd_call(
+        q, k, v, out, lse[:, :, None, :], do, masks, causal, sm_scale, bq, bk, interpret
+    )
 
 
 def dot_product_attention(
@@ -384,16 +581,21 @@ def dot_product_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     bias: Optional[jax.Array] = None,
+    kv_mask: Optional[jax.Array] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
     impl: str = "auto",
     interpret: bool = False,
 ) -> jax.Array:
     """Attention dispatcher: pallas flash kernel on TPU when shapes allow,
     XLA reference otherwise. Layout [B, H, S, D]. ``impl`` ∈
-    {"auto", "flash", "xla"}. A ``bias`` (padding mask) routes to the XLA
-    path — the kernel handles the causal mask only; asking for "flash" with
-    a bias is an error rather than a silent downgrade."""
+    {"auto", "flash", "xla"}.
+
+    Padding should arrive as ``kv_mask`` and packed sequences as
+    ``segment_ids`` — both stay on the flash path. An arbitrary additive
+    ``bias`` falls back to XLA (the kernel implements masks, not biases)."""
     if impl == "flash" and bias is not None:
-        raise ValueError("flash impl does not support bias; use impl='auto' or 'xla'")
+        raise ValueError("flash impl does not support arbitrary bias; use kv_mask/segment_ids or impl='xla'")
     if impl == "xla" or bias is not None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)
     on_tpu = jax.default_backend() == "tpu"
@@ -402,6 +604,16 @@ def dot_product_attention(
     )
     if impl == "flash" or (impl == "auto" and (on_tpu or interpret) and blocks_ok):
         return flash_attention(
-            q, k, v, causal=causal, sm_scale=sm_scale, interpret=interpret or not on_tpu
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            kv_mask=kv_mask, q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+            interpret=interpret or not on_tpu,
         )
-    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    if kv_mask is not None or q_segment_ids is not None:
+        bias_parts = []
+        if kv_mask is not None:
+            bias_parts.append(jnp.where(kv_mask[:, None, None, :] != 0, 0.0, NEG_INF))
+        if q_segment_ids is not None:
+            same = q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
+            bias_parts.append(jnp.where(same, 0.0, NEG_INF))
+        bias = sum(bias_parts)
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)

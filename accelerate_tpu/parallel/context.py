@@ -61,10 +61,27 @@ def ring_attention(
     axis_size: int,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    impl: str = "auto",
+    interpret: bool = False,
 ) -> jax.Array:
     """Per-shard body (call under shard_map). q/k/v: local shards
-    [B, H, S/n, D]; sequence order is the mesh axis order."""
+    [B, H, S/n, D]; sequence order is the mesh axis order.
+
+    ``impl``: "flash" uses the pallas kernel as the inner step (VMEM-resident
+    scores, a ring-level custom VJP runs a reverse ring of dq/dkv kernels);
+    "dense" materializes the local [Sq, Skv] fp32 block (any shape);
+    "auto" picks flash when the local shapes tile (128-multiples)."""
     sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s_local, d = q.shape[2], q.shape[3]
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "flash" if (s_local % 128 == 0 and d % 128 == 0 and (on_tpu or interpret)) else "dense"
+    if impl == "flash":
+        return _ring_flash(q, k, v, axis_name, axis_size, causal, sm_scale, interpret)
+    return _ring_dense(q, k, v, axis_name, axis_size, causal, sm_scale)
+
+
+def _ring_dense(q, k, v, axis_name, axis_size, causal, sm_scale):
     n = axis_size
     i = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
@@ -97,6 +114,122 @@ def ring_attention(
     return o_acc.astype(dtype)
 
 
+# ---------------------------------------------------------------------------
+# flash inner step: the pallas kernel per ring hop + ring-level custom VJP
+# ---------------------------------------------------------------------------
+#
+# Per hop r, the chunk I hold is j = (i - r) % n — traced, so the causal
+# structure is a 3-way lax.switch: j < i full block, j == i causal block,
+# j > i contributes nothing (the kernel call is skipped entirely, unlike the
+# dense path which burns FLOPs on a fully masked block).
+#
+# The backward runs the ring again: with the GLOBAL lse and delta, the
+# per-block flash backward contributions (p = exp(s - lse)) sum exactly, so
+# dq accumulates locally while dk/dv accumulate on buffers that travel WITH
+# k/v — after n hops they land back on the chunk's owner.
+
+
+def _hop_cases(q, k_cur, v_cur, sm_scale, fwd=True, out=None, lse=None, do=None, interpret=False):
+    from ..ops.attention import flash_attention_bwd, flash_attention_with_lse
+
+    if fwd:
+        def full(_):
+            return flash_attention_with_lse(q, k_cur, v_cur, causal=False, sm_scale=sm_scale, interpret=interpret)
+
+        def diag(_):
+            return flash_attention_with_lse(q, k_cur, v_cur, causal=True, sm_scale=sm_scale, interpret=interpret)
+
+        def skip(_):
+            return (
+                jnp.zeros(q.shape[:3] + (v_cur.shape[-1],), q.dtype),
+                jnp.full(q.shape[:3], NEG_INF, jnp.float32),
+            )
+
+        return full, diag, skip
+
+    def full_b(_):
+        return flash_attention_bwd(q, k_cur, v_cur, out, lse, do, causal=False, sm_scale=sm_scale, interpret=interpret)
+
+    def diag_b(_):
+        return flash_attention_bwd(q, k_cur, v_cur, out, lse, do, causal=True, sm_scale=sm_scale, interpret=interpret)
+
+    def skip_b(_):
+        return jnp.zeros_like(q), jnp.zeros_like(k_cur), jnp.zeros_like(v_cur)
+
+    return full_b, diag_b, skip_b
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, axis_size, causal, sm_scale, interpret):
+    out, _ = _ring_flash_fwd_loop(q, k, v, axis_name, axis_size, causal, sm_scale, interpret)
+    return out
+
+
+def _case_index(j, i, causal):
+    # 0 = full block, 1 = causal diagonal block, 2 = skip
+    if not causal:
+        return jnp.int32(0)
+    return jnp.where(j == i, 1, jnp.where(j < i, 0, 2)).astype(jnp.int32)
+
+
+def _ring_flash_fwd_loop(q, k, v, axis_name, axis_size, causal, sm_scale, interpret):
+    n = axis_size
+    i = jax.lax.axis_index(axis_name)
+    dtype = q.dtype
+    o_acc = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    lse_acc = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    k_cur, v_cur = k, v
+    fwd_perm = [(p_, (p_ + 1) % n) for p_ in range(n)]
+    for r in range(n):
+        j = (i - r) % n
+        full, diag, skip = _hop_cases(q, k_cur, v_cur, sm_scale, fwd=True, interpret=interpret)
+        o_r, lse_r = jax.lax.switch(_case_index(j, i, causal), [full, diag, skip], ())
+        new_lse = jnp.logaddexp(lse_acc, lse_r)
+        w_old = jnp.exp(lse_acc - new_lse)[..., None]
+        w_new = jnp.exp(lse_r - new_lse)[..., None]
+        o_acc = o_acc * w_old + o_r.astype(jnp.float32) * w_new
+        lse_acc = new_lse
+        if r != n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, fwd_perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, fwd_perm)
+    return o_acc.astype(dtype), lse_acc
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, axis_size, causal, sm_scale, interpret):
+    out, lse = _ring_flash_fwd_loop(q, k, v, axis_name, axis_size, causal, sm_scale, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, axis_size, causal, sm_scale, interpret, res, do):
+    q, k, v, out, lse = res
+    n = axis_size
+    i = jax.lax.axis_index(axis_name)
+    fwd_perm = [(p_, (p_ + 1) % n) for p_ in range(n)]
+    dq_acc = jnp.zeros(q.shape, jnp.float32)
+    dk_cur = jnp.zeros(k.shape, jnp.float32)
+    dv_cur = jnp.zeros(v.shape, jnp.float32)
+    k_cur, v_cur = k, v
+    for r in range(n):
+        j = (i - r) % n
+        full_b, diag_b, skip_b = _hop_cases(
+            q, k_cur, v_cur, sm_scale, fwd=False, out=out, lse=lse, do=do, interpret=interpret
+        )
+        dq_r, dk_r, dv_r = jax.lax.switch(_case_index(j, i, causal), [full_b, diag_b, skip_b], ())
+        dq_acc = dq_acc + dq_r.astype(jnp.float32)
+        dk_cur = dk_cur + dk_r.astype(jnp.float32)
+        dv_cur = dv_cur + dv_r.astype(jnp.float32)
+        # rotate after EVERY hop (n total): the k/dk buffers complete the
+        # full cycle and land back on the chunk owner
+        k_cur = jax.lax.ppermute(k_cur, axis_name, fwd_perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, fwd_perm)
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, fwd_perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, fwd_perm)
+    return dq_acc.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,
@@ -106,6 +239,8 @@ def ring_attention_sharded(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     seq_axis: str = "sequence",
+    impl: str = "auto",
+    interpret: bool = False,
 ) -> jax.Array:
     """Global-view entry: q [B, H, S, D] (any resharding handled by jit),
     sequence sharded over ``seq_axis``, heads over "tensor", batch over the
@@ -149,6 +284,8 @@ def ring_attention_sharded(
             axis_size=n,
             causal=causal,
             sm_scale=sm_scale,
+            impl=impl,
+            interpret=interpret,
         ),
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec),

@@ -83,6 +83,93 @@ class TestFlashAttention:
         ref = mha_reference(q, k, v, causal=True)
         np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_kv_mask_matches_bias_reference(self, causal):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(6), b=2, s=256)
+        rng = np.random.RandomState(0)
+        lengths = rng.randint(64, 256, size=2)
+        kv_mask = (np.arange(256)[None, :] < lengths[:, None]).astype(np.int32)
+        out = flash_attention(q, k, v, causal=causal, kv_mask=jnp.asarray(kv_mask), interpret=True)
+        from accelerate_tpu.ops.attention import NEG_INF
+
+        bias = jnp.where(jnp.asarray(kv_mask)[:, None, None, :] != 0, 0.0, NEG_INF)
+        ref = mha_reference(q, k, v, causal=causal, bias=bias)
+        # only unpadded query rows are meaningful (padded rows never feed loss)
+        valid_q = kv_mask.astype(bool)
+        np.testing.assert_allclose(
+            np.asarray(out)[:, :, valid_q[0], :][:1],
+            np.asarray(ref)[:, :, valid_q[0], :][:1],
+            atol=2e-5, rtol=2e-5,
+        )
+        for bi in range(2):
+            rows = np.nonzero(valid_q[bi])[0]
+            np.testing.assert_allclose(
+                np.asarray(out)[bi][:, rows], np.asarray(ref)[bi][:, rows], atol=2e-5, rtol=2e-5
+            )
+
+    def test_kv_mask_grads_match_reference(self):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(7), b=2, s=256)
+        kv_mask = jnp.asarray(
+            (np.arange(256)[None, :] < np.array([[200], [128]])).astype(np.int32)
+        )
+        from accelerate_tpu.ops.attention import NEG_INF
+
+        bias = jnp.where(kv_mask[:, None, None, :] != 0, 0.0, NEG_INF)
+        # weight the loss by the query mask so padded rows don't contribute
+        w = kv_mask[:, None, :, None].astype(q.dtype)
+
+        def loss_flash(q, k, v):
+            return jnp.sum((flash_attention(q, k, v, kv_mask=kv_mask, interpret=True) * w) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum((mha_reference(q, k, v, bias=bias) * w) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+    def test_segment_ids_block_cross_attention(self):
+        # two packed sequences per row: tokens must not attend across the seam
+        q, k, v = _rand_qkv(jax.random.PRNGKey(8), b=1, s=256)
+        seg = jnp.asarray((np.arange(256) >= 128).astype(np.int32))[None, :]
+        out = flash_attention(
+            q, k, v, causal=True, q_segment_ids=seg, kv_segment_ids=seg, interpret=True
+        )
+        # reference: causal + segment bias
+        from accelerate_tpu.ops.attention import NEG_INF
+
+        same = seg[:, None, :, None] == seg[:, None, None, :]
+        bias = jnp.where(same, 0.0, NEG_INF)
+        ref = mha_reference(q, k, v, causal=True, bias=bias)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+        # and grads
+        gf = jax.grad(
+            lambda q: jnp.sum(
+                flash_attention(q, k, v, causal=True, q_segment_ids=seg, kv_segment_ids=seg, interpret=True) ** 2
+            )
+        )(q)
+        gr = jax.grad(lambda q: jnp.sum(mha_reference(q, k, v, causal=True, bias=bias) ** 2))(q)
+        np.testing.assert_allclose(gf, gr, atol=5e-4, rtol=5e-4)
+
+    def test_gqa_with_kv_mask(self):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(9), h=4, kvh=2, s=256)
+        kv_mask = jnp.asarray((np.arange(256) < 192).astype(np.int32))[None, :]
+        from accelerate_tpu.ops.attention import NEG_INF
+
+        bias = jnp.where(kv_mask[:, None, None, :] != 0, 0.0, NEG_INF)
+        out = flash_attention(q, k, v, causal=True, kv_mask=kv_mask, interpret=True)
+        ref = mha_reference(q, k, v, causal=True, bias=bias)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_dispatcher_routes_kv_mask_to_kernel_shapes(self):
+        # kv_mask path: dispatcher must not fall back to XLA for maskable pads
+        q, k, v = _rand_qkv(jax.random.PRNGKey(10), s=256)
+        kv_mask = jnp.ones((1, 256), jnp.int32)
+        out = dot_product_attention(q, k, v, kv_mask=kv_mask, interpret=True)
+        ref = mha_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
 
 class TestLayers:
     def test_rms_norm_matches_manual(self):
