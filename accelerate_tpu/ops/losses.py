@@ -68,8 +68,14 @@ def fused_linear_cross_entropy(
                 break
     chunk = n // num_chunks
 
-    h_chunks = hidden.reshape(num_chunks, chunk, e)
-    l_chunks = labels.reshape(num_chunks, chunk)
+    # STRIDED chunking (chunk c = rows {c, c+C, c+2C, ...}): the token dim is
+    # sharded over the data axes in contiguous blocks, so the reshape must
+    # split the major (sharded) dim for the per-chunk row dim to inherit the
+    # sharding — a contiguous [C, chunk] split would shard the scan dim and
+    # force the SPMD partitioner into full rematerialization per slice. The
+    # loss is a masked mean over all rows, so the permutation is irrelevant.
+    h_chunks = hidden.reshape(chunk, num_chunks, e).swapaxes(0, 1)
+    l_chunks = labels.reshape(chunk, num_chunks).swapaxes(0, 1)
 
     @jax.checkpoint
     def chunk_loss(h, lab):

@@ -53,11 +53,16 @@ class PipelineStages(nn.Module):
     # logical axes of the [stage, microbatch, ...] activation buffer; callers
     # with non-[b,s,e] stage bodies supply their own
     buffer_logical_axes: tuple = ("stage", "batch", "seq", "embed")
+    # the [M, mb, ...] outputs accumulator: M is a schedule dim (unsharded);
+    # without this pin the SPMD partitioner invents a degenerate sharding
+    # for the loop carry and resharding it after the while is a full remat
+    outputs_logical_axes: tuple = (None, "batch", "seq", "embed")
 
     @nn.compact
     def __call__(self, x_microbatches: jax.Array, *consts):
         S, M = self.num_stages, self.num_microbatches
         steps = pipeline_round_trip_steps(M, S)
+        x_microbatches = self._constrain_outputs(x_microbatches)
 
         # Stage-vmapped module: params [S, ...] with partition name "stage".
         Stages = nn.vmap(
@@ -82,13 +87,14 @@ class PipelineStages(nn.Module):
                 out_idx = t - (S - 1)
                 clamped = jnp.clip(out_idx, 0, M - 1)
                 current = jax.lax.dynamic_index_in_dim(outputs, clamped, 0, keepdims=False)
-                done = jnp.where(out_idx >= 0, y[-1], current)
+                done = outer._constrain_slice(jnp.where(out_idx >= 0, y[-1], current))
                 outputs = jax.lax.dynamic_update_index_in_dim(outputs, done, clamped, 0)
+                outputs = outer._constrain_outputs(outputs)
                 # advance the belt: stage 0 takes the next microbatch, stage
                 # i takes stage i-1's output (a neighbor collective-permute)
                 nxt = jnp.clip(t + 1, 0, M - 1)
                 feed = jax.lax.dynamic_index_in_dim(x_microbatches, nxt, 0, keepdims=False)
-                feed = jnp.where(t + 1 < M, feed, jnp.zeros_like(feed))
+                feed = outer._constrain_slice(jnp.where(t + 1 < M, feed, jnp.zeros_like(feed)))
                 buffer = jnp.concatenate([feed[None], y[:-1]], axis=0)
                 buffer = outer._constrain_buffer(buffer)
                 return (buffer, outputs), None
@@ -109,7 +115,7 @@ class PipelineStages(nn.Module):
             axis=0,
         )
         buffer0 = self._constrain_buffer(buffer0)
-        outputs0 = jnp.zeros_like(x_microbatches)
+        outputs0 = self._constrain_outputs(jnp.zeros_like(x_microbatches))
         (_, outputs), _ = TimeLoop(name="schedule")(
             (buffer0, outputs0), jnp.arange(steps)
         )
@@ -120,20 +126,40 @@ class PipelineStages(nn.Module):
 
         return constrain_activation(buf, self.buffer_logical_axes, self.mesh)
 
+    def _constrain_outputs(self, buf):
+        from .sharding import constrain_activation
+
+        return constrain_activation(buf, self.outputs_logical_axes, self.mesh)
+
+    def _constrain_slice(self, x):
+        from .sharding import constrain_activation
+
+        return constrain_activation(x, self.outputs_logical_axes[1:], self.mesh)
+
 
 def split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
-    """[B, ...] -> [M, B/M, ...] (consecutive rows per microbatch)."""
+    """[B, ...] -> [M, B/M, ...], microbatch m = rows {m, m+M, m+2M, ...}.
+
+    The STRIDED assignment is deliberate: the batch dim is sharded over the
+    data axes in contiguous blocks, so the reshape must split the MAJOR
+    (sharded) dim — [B] -> [mb, M] -> swap — for the mb dim to inherit the
+    batch sharding without resharding. The naive [M, B/M] contiguous split
+    puts the sharding on the schedule dim M, which the SPMD partitioner can
+    only undo by full rematerialization (the round-1 dryrun warning).
+    merge_microbatches inverts exactly, so training semantics are
+    unaffected (row order within the global batch is restored)."""
     b = x.shape[0]
     if b % num_microbatches != 0:
         raise ValueError(
             f"batch {b} is not divisible by num_microbatches={num_microbatches}"
         )
-    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+    mb = b // num_microbatches
+    return x.reshape(mb, num_microbatches, *x.shape[1:]).swapaxes(0, 1)
 
 
 def merge_microbatches(y: jax.Array) -> jax.Array:
-    """[M, mb, ...] -> [B, ...]."""
-    return y.reshape(y.shape[0] * y.shape[1], *y.shape[2:])
+    """[M, mb, ...] -> [B, ...] (inverse of split_microbatches)."""
+    return y.swapaxes(0, 1).reshape(y.shape[0] * y.shape[1], *y.shape[2:])
 
 
 def stack_layers_to_stages(stacked_params, num_stages: int):
