@@ -64,31 +64,48 @@ def save_accelerator_state(
     trainer_state = {"step": step, "engines": []}
     for i, engine in enumerate(engines):
         sd = engine.state_dict()
-        # Materialize sharded arrays on EVERY host first: gathering a
-        # non-fully-addressable array is a collective all ranks must join
-        # (writing the file, below, is main-process-only).
-        from .utils.serialization import _to_numpy
-
         model_tree = {"params": sd["params"]}
         if "extra_state" in sd:
             model_tree["extra_state"] = sd["extra_state"]
-        model_tree = jax.tree_util.tree_map(_to_numpy, model_tree)
-        opt_flat = (
-            {k: _to_numpy(v) for k, v in _arrays_only(sd["opt_state"]).items()}
-            if sd.get("opt_state") is not None
-            else None
-        )
-        if state.is_main_process:
-            save_pytree(model_tree, os.path.join(output_dir, f"{MODEL_NAME}_{i}.{ext}"),
-                        safe_serialization=safe_serialization)
-            logger.info(f"Model weights saved in {output_dir}/{MODEL_NAME}_{i}.{ext}")
+        opt_flat = _arrays_only(sd["opt_state"]) if sd.get("opt_state") is not None else None
+
+        if safe_serialization and _is_sharded_tree(model_tree):
+            # Sharded save: every process writes ITS unique shards straight
+            # from device into a per-rank safetensors file (one shard in
+            # host memory at a time) — no host ever gathers the full tree.
+            from .utils.serialization import save_pytree_dist
+
+            save_pytree_dist(
+                model_tree, os.path.join(output_dir, f"{MODEL_NAME}_{i}"),
+                process_index=state.process_index,
+            )
+            logger.info(f"Model weights saved sharded in {output_dir}/{MODEL_NAME}_{i}.rank*")
             if opt_flat is not None:
-                save_pytree(
-                    opt_flat,
-                    os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}.{ext}"),
-                    safe_serialization=safe_serialization,
+                save_pytree_dist(
+                    opt_flat, os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}"),
+                    process_index=state.process_index,
                 )
-                logger.info(f"Optimizer state saved in {output_dir}/{OPTIMIZER_NAME}_{i}.{ext}")
+                logger.info(f"Optimizer state saved sharded in {output_dir}/{OPTIMIZER_NAME}_{i}.rank*")
+        else:
+            # replicated/small case: consolidate on host, main process writes
+            # (gathering non-addressable arrays is a collective all ranks join)
+            from .utils.serialization import _to_numpy
+
+            model_tree = jax.tree_util.tree_map(_to_numpy, model_tree)
+            opt_np = (
+                {k: _to_numpy(v) for k, v in opt_flat.items()} if opt_flat is not None else None
+            )
+            if state.is_main_process:
+                save_pytree(model_tree, os.path.join(output_dir, f"{MODEL_NAME}_{i}.{ext}"),
+                            safe_serialization=safe_serialization)
+                logger.info(f"Model weights saved in {output_dir}/{MODEL_NAME}_{i}.{ext}")
+                if opt_np is not None:
+                    save_pytree(
+                        opt_np,
+                        os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}.{ext}"),
+                        safe_serialization=safe_serialization,
+                    )
+                    logger.info(f"Optimizer state saved in {output_dir}/{OPTIMIZER_NAME}_{i}.{ext}")
         meta = {"step_count": sd["step_count"]}
         if "scale" in sd:
             meta["scale"] = {k: float(np.asarray(jax.device_get(v))) for k, v in sd["scale"].items()}
@@ -251,12 +268,29 @@ def _parse_size(size) -> int:
 
 
 def _find(folder: str, stem: str) -> Optional[str]:
-    """Locate `stem`.{safetensors,bin} (or its sharded index) in `folder`."""
+    """Locate `stem`.{safetensors,bin} (or its sharded/distributed index)."""
+    from .utils.serialization import _find_dist_manifests
+
+    base = os.path.join(folder, stem)
+    if _find_dist_manifests(base):
+        return base  # load_flat_dict reassembles from the rank manifests
     for ext in (".safetensors.index.json", ".safetensors", ".bin"):
-        p = os.path.join(folder, stem + ext)
+        p = base + ext
         if os.path.exists(p):
             return p
     return None
+
+
+def _is_sharded_tree(tree) -> bool:
+    """True if any leaf is a jax.Array spread over more than one device."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            try:
+                if len(leaf.sharding.device_set) > 1:
+                    return True
+            except Exception:  # pragma: no cover
+                continue
+    return False
 
 
 def _arrays_only(tree):

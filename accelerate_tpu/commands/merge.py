@@ -22,15 +22,24 @@ def register(subparsers):
 def merge_command(args) -> int:
     from ..utils.serialization import load_flat_dict, save_pytree
 
+    import glob
+
     src = args.checkpoint_dir
     # accept either the checkpoint dir itself or one containing model.safetensors*
+    # or a per-rank distributed checkpoint (model_0.rank*.manifest.json)
     candidates = [src]
     if os.path.isdir(src):
-        for stem in ("model.safetensors", "model.safetensors.index.json", "model.bin"):
-            p = os.path.join(src, stem)
-            if os.path.exists(p):
-                candidates.insert(0, p)
-                break
+        manifests = sorted(glob.glob(os.path.join(src, "*.rank*.manifest.json")))
+        if manifests:
+            base = manifests[0].split(".rank")[0]
+            candidates.insert(0, base)
+        else:
+            for stem in ("model.safetensors", "model.safetensors.index.json",
+                         "model_0.safetensors", "model.bin"):
+                p = os.path.join(src, stem)
+                if os.path.exists(p):
+                    candidates.insert(0, p)
+                    break
     flat = load_flat_dict(candidates[0])
     out = args.output_path
     os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
