@@ -638,6 +638,22 @@ class TrainEngine:
         return apply_fn
 
 
+def _enable_fp8(definition):
+    """Flip ``config.use_fp8`` on a model definition that supports the fp8
+    recipe (ops/fp8.py); definitions without the knob pass through — their
+    matmuls simply stay bf16 (the reference likewise only converts layers
+    TE has fp8 kernels for)."""
+    import dataclasses as _dc
+
+    cfg = getattr(definition, "config", None)
+    if cfg is None or not hasattr(cfg, "use_fp8") or cfg.use_fp8:
+        return definition
+    try:
+        return definition.copy(config=_dc.replace(cfg, use_fp8=True))
+    except Exception:  # pragma: no cover - exotic module types
+        return definition
+
+
 def _split_static_call(args, kwargs):
     """Partition call inputs: bool/str/bytes/None/enum values become jit
     statics (they feed Python control flow in user modules); arrays, numbers,
@@ -892,6 +908,8 @@ class Accelerator:
             )
         if model.loss_fn is None and self.loss_fn is not None:
             model.loss_fn = self.loss_fn
+        if self.mixed_precision == "fp8":
+            model.definition = _enable_fp8(model.definition)
         engine = TrainEngine(model, self)
         self._engines.append(engine)
         prepared = PreparedModel(engine)

@@ -176,11 +176,17 @@ class DispatchedModel:
                 return jax.device_put(leaf, sh)
 
             def apply(p, a, kw, s_args, s_kw):
+                from .utils.quantization import dequantize_params
+
                 a = list(a)
                 for i, v in s_args:
                     a[i] = v
                 kw = dict(kw, **dict(s_kw))
                 p = jax.tree_util.tree_map(_place, p, shardings)
+                # int8/int4 weights dequantize in-graph here; XLA fuses the
+                # (data * scale) into the consuming matmul, so the resident
+                # form stays quantized
+                p = dequantize_params(p)
                 return self.definition.apply({"params": p}, *a, **kw)
 
             self._apply = apply
@@ -294,6 +300,40 @@ class _HookedModel:
 
     def __getattr__(self, name):
         return getattr(self._model, name)
+
+
+def load_and_quantize_model(
+    definition,
+    weights,
+    quantization_config,
+    device_map: Any = None,
+    offload_folder: Optional[str] = None,
+    mesh=None,
+) -> DispatchedModel:
+    """Quantize a model's weights to int8/int4 and return a runnable
+    (reference utils/bnb.py:44 load_and_quantize_model). ``weights`` is a
+    params pytree or a checkpoint path; quantized tensors live on device in
+    their packed form and dequantize in-graph per call."""
+    from .utils.quantization import quantize_params
+    from .utils.serialization import load_flat_dict, unflatten_to_like
+
+    if isinstance(weights, str) or hasattr(weights, "__fspath__"):
+        flat = load_flat_dict(str(weights))
+        params = {k: jnp.asarray(v) for k, v in flat.items()}
+        # checkpoint keys are flat paths; rebuild nesting
+        nested: dict = {}
+        for key, val in params.items():
+            node = nested
+            parts = key.split("/")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = val
+        params = nested
+    else:
+        params = weights
+    qparams = quantize_params(params, quantization_config)
+    dm = device_map if isinstance(device_map, dict) else {"": "device"}
+    return dispatch_model(definition, qparams, dm, mesh=mesh, offload_folder=offload_folder)
 
 
 def cpu_offload_with_hook(definition, params, mesh=None, prev_module_hook: CpuOffloadHook | None = None):

@@ -41,6 +41,9 @@ class DecoderConfig:
     # params + GPipe microbatch schedule (parallel/pipeline.py)
     pipeline_stages: int = 1
     pipeline_microbatches: Optional[int] = None  # None -> pipeline_stages
+    # fp8 recipe (ops/fp8.py): MLP contractions run e4m3-fwd/e5m2-bwd with
+    # current scaling. Flipped on by Accelerator(mixed_precision="fp8").
+    use_fp8: bool = False
     # big-model inference: keep layer weights in pinned host RAM and
     # transfer each layer's slice to HBM inside the scan body, so peak HBM
     # is ~one layer + embedding, not the whole model (set automatically by

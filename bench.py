@@ -119,7 +119,14 @@ def _ttft_bench(cfg, prompt_len, tmpdir):
 
 
 def main():
+    import argparse
+
     from accelerate_tpu.models import DecoderConfig
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--fp8", action="store_true",
+                        help="Also run the flagship config under the fp8 recipe and report its MFU")
+    args, _ = parser.parse_known_args()
 
     on_tpu = jax.default_backend() == "tpu"
     extra = {}
@@ -154,6 +161,11 @@ def main():
         lc_tok_s, lc_mfu, _, _ = _train_bench(longctx, 1, 16_384, 5, "bf16")
         extra["long16k_train_mfu_pct"] = round(lc_mfu * 100, 2)
         extra["long16k_tokens_per_sec"] = round(lc_tok_s)
+
+        if args.fp8:
+            fp8_tok_s, fp8_mfu, _, _ = _train_bench(flagship, 8, 2048, 10, "fp8")
+            extra["fp8_train_mfu_pct"] = round(fp8_mfu * 100, 2)
+            extra["fp8_tokens_per_sec"] = round(fp8_tok_s)
 
         import tempfile
 

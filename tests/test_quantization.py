@@ -1,0 +1,134 @@
+"""fp8 recipe (ops/fp8.py) + int8/int4 weight-only quantization
+(utils/quantization.py) numerics on the CPU sim."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.ops.fp8 import E4M3_MAX, fp8_dot, quantize_fp8
+from accelerate_tpu.utils.quantization import (
+    QuantizationConfig,
+    QuantizedWeight,
+    dequantize_array,
+    dequantize_params,
+    quantize_array,
+    quantize_params,
+)
+
+
+class TestFp8:
+    def test_quantize_roundtrip_scale(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 64)) * 3.0
+        q, scale = quantize_fp8(x)
+        back = q.astype(jnp.float32) * scale
+        # e4m3 has ~2 decimal digits; relative error bounded by the format
+        np.testing.assert_allclose(back, x, atol=float(scale) * 8, rtol=0.07)
+
+    def test_fp8_dot_close_to_exact(self):
+        a = jax.random.normal(jax.random.PRNGKey(1), (32, 128))
+        b = jax.random.normal(jax.random.PRNGKey(2), (128, 64))
+        out = fp8_dot(a, b)
+        exact = a @ b
+        # fp8 matmul error: relative to the result's magnitude scale
+        denom = float(np.abs(np.asarray(exact)).max())
+        assert float(np.max(np.abs(np.asarray(out - exact)))) / denom < 0.05
+
+    def test_fp8_dot_grads_flow(self):
+        a = jax.random.normal(jax.random.PRNGKey(3), (8, 64))
+        b = jax.random.normal(jax.random.PRNGKey(4), (64, 32))
+        ga, gb = jax.grad(lambda a, b: jnp.sum(fp8_dot(a, b) ** 2), argnums=(0, 1))(a, b)
+        ga_ref, gb_ref = jax.grad(lambda a, b: jnp.sum((a @ b) ** 2), argnums=(0, 1))(a, b)
+        for g, r in zip((ga, gb), (ga_ref, gb_ref)):
+            denom = float(np.abs(np.asarray(r)).max())
+            assert float(np.max(np.abs(np.asarray(g - r)))) / denom < 0.1
+
+    def test_fp8_training_decreases_loss(self):
+        from accelerate_tpu import Accelerator, Model
+        from accelerate_tpu.models import DecoderConfig, DecoderLM
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        accelerator = Accelerator(mixed_precision="fp8")
+        cfg = DecoderConfig.tiny()
+        model_def = DecoderLM(cfg)
+        variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=2, seq_len=32)
+        model, optimizer = accelerator.prepare(Model(model_def, variables), optax.adam(1e-2))
+        # the recipe must actually be enabled on the prepared definition
+        assert model._engine.model.definition.config.use_fp8
+        step = accelerator.build_train_step()
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 32))
+        batch = accelerator.prepare_for_eval({"input_ids": ids, "labels": ids})
+        losses = [float(jax.device_get(step(batch)["loss"])) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+
+
+class TestWeightOnlyQuant:
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_roundtrip_error_bounded(self, bits):
+        w = jax.random.normal(jax.random.PRNGKey(0), (256, 64))
+        qw = quantize_array(w, bits=bits, group_size=128)
+        back = dequantize_array(qw)
+        assert back.shape == w.shape and back.dtype == w.dtype
+        qmax = 2 ** (bits - 1) - 1
+        # max error is half a quantization step per group
+        step_bound = float(jnp.max(jnp.abs(w))) / qmax
+        assert float(jnp.max(jnp.abs(back - w))) <= step_bound
+
+    def test_int4_packs_two_per_byte(self):
+        w = jax.random.normal(jax.random.PRNGKey(1), (128, 32))
+        q8 = quantize_array(w, bits=8)
+        q4 = quantize_array(w, bits=4)
+        assert q4.data.shape[0] == q8.data.shape[0] // 2
+        assert q4.data.dtype == jnp.int8
+
+    def test_quantized_weight_is_pytree(self):
+        qw = quantize_array(jnp.ones((16, 8)), bits=8, group_size=8)
+        leaves = jax.tree_util.tree_leaves(qw)
+        assert len(leaves) == 2  # data + scale
+        mapped = jax.tree_util.tree_map(lambda x: x, qw)
+        assert isinstance(mapped, QuantizedWeight)
+
+    def test_quantize_params_skips_embeddings_and_vectors(self):
+        params = {
+            "embedding": jnp.ones((32, 8)),
+            "layers": {"w_gate": jnp.ones((8, 16)), "ln_attn": jnp.ones((8,))},
+        }
+        q = quantize_params(params, QuantizationConfig(load_in_8bit=True))
+        assert not isinstance(q["embedding"], QuantizedWeight)  # skip_modules
+        assert isinstance(q["layers"]["w_gate"], QuantizedWeight)
+        assert not isinstance(q["layers"]["ln_attn"], QuantizedWeight)  # vector
+        deq = dequantize_params(q)
+        assert deq["layers"]["w_gate"].shape == (8, 16)
+
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_load_and_quantize_model_matches_dense(self, bits):
+        from accelerate_tpu.big_modeling import load_and_quantize_model
+        from accelerate_tpu.models import DecoderConfig, DecoderLM
+        from accelerate_tpu.parallel.sharding import unbox_params
+
+        cfg = DecoderConfig.tiny()
+        model = DecoderLM(cfg)
+        variables = model.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+        params, _ = unbox_params(variables["params"])
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (1, 16)))
+        ref = model.apply({"params": params}, ids)["logits"]
+
+        config = QuantizationConfig(load_in_8bit=bits == 8, load_in_4bit=bits == 4, group_size=32)
+        qmodel = load_and_quantize_model(model, params, config)
+        out = qmodel(ids)["logits"]
+        # weight-only quant: logits close in distribution, argmax mostly stable
+        ref_n = np.asarray(ref)
+        out_n = np.asarray(out)
+        rel = np.abs(out_n - ref_n) / (np.abs(ref_n).max() + 1e-6)
+        assert float(rel.max()) < (0.05 if bits == 8 else 0.35), rel.max()
+
+    def test_quantized_checkpoint_roundtrip(self, tmp_path):
+        from accelerate_tpu.utils.serialization import load_flat_dict, save_pytree
+
+        qw = quantize_array(jax.random.normal(jax.random.PRNGKey(2), (64, 16)), bits=8)
+        save_pytree({"w": qw}, str(tmp_path / "q.safetensors"))
+        back = load_flat_dict(str(tmp_path / "q.safetensors"))
+        # pytree flattening exposes data + scale as separate tensors
+        assert any("w" in k for k in back)
