@@ -16,6 +16,11 @@ def register(subparsers):
     default_p.add_argument("--config_file", default=None)
     default_p.add_argument("--mixed_precision", default="no", choices=["no", "fp16", "bf16"])
     default_p.set_defaults(func=default_command)
+    update_p = sub.add_parser(
+        "update", help="Rewrite an existing config with the current schema (add new fields, drop stale ones)"
+    )
+    update_p.add_argument("--config_file", default=None)
+    update_p.set_defaults(func=update_command)
     parser.set_defaults(func=config_command)
     return parser
 
@@ -57,6 +62,22 @@ def config_command(args) -> int:
     path = args.config_file or default_config_file()
     cfg.to_yaml_file(path)
     print(f"accelerate-tpu configuration saved at {path}")
+    return 0
+
+
+def update_command(args) -> int:
+    """reference commands/config/update.py: round-trip the yaml through the
+    current ClusterConfig so version migrations add new fields with their
+    defaults and unknown/stale keys are dropped."""
+    from .config_args import load_config_from_file
+
+    path = args.config_file or default_config_file()
+    if not os.path.isfile(path):
+        print(f"No config file found at {path}; run `accelerate-tpu config` first")
+        return 1
+    cfg = load_config_from_file(path)
+    cfg.to_yaml_file(path)
+    print(f"accelerate-tpu configuration updated in place at {path}")
     return 0
 
 

@@ -1,0 +1,81 @@
+"""`accelerate-tpu tpu-config` — run setup/install commands on every worker
+of a TPU pod (parity: reference commands/tpu.py `accelerate tpu-config`:
+gcloud ssh fan-out with optional `pip install` of the training deps).
+
+`launch` already fans the training job out; this command covers the
+one-time environment setup the reference's tpu-config does: installing
+packages, syncing code, or arbitrary shell on `--worker=all`.
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+
+from .config_args import load_config_from_file
+
+
+def register(subparsers):
+    parser = subparsers.add_parser(
+        "tpu-config", help="Run setup commands on every TPU pod worker"
+    )
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--tpu_name", default=None)
+    parser.add_argument("--tpu_zone", default=None)
+    parser.add_argument("--tpu_project", default=None)
+    parser.add_argument(
+        "--command", action="append", default=None,
+        help="Command to run on all workers (repeatable; joined with '; ')",
+    )
+    parser.add_argument(
+        "--install_accelerate", action="store_true",
+        help="pip install this package on every worker first",
+    )
+    parser.add_argument(
+        "--accelerate_version", default="latest",
+        help="Version to install with --install_accelerate ('latest' or a pin)",
+    )
+    parser.add_argument("--use_sudo", action="store_true", help="Run setup commands under sudo")
+    parser.add_argument("--debug", action="store_true", help="Print the gcloud command instead of running it")
+    parser.set_defaults(func=tpu_config_command)
+    return parser
+
+
+def build_remote_command(args, config) -> list:
+    commands = []
+    if args.install_accelerate:
+        if args.accelerate_version == "latest":
+            spec = "accelerate-tpu"
+        else:
+            spec = f"accelerate-tpu=={args.accelerate_version}"
+        commands.append(f"pip install -U {shlex.quote(spec)}")
+    commands.extend(args.command or [])
+    if not commands:
+        raise ValueError("nothing to run: pass --command and/or --install_accelerate")
+    if args.use_sudo:
+        commands = [f"sudo {c}" for c in commands]
+    remote = "; ".join(commands)
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh",
+        args.tpu_name or config.tpu_name,
+        f"--zone={args.tpu_zone or config.tpu_zone}",
+        "--worker=all",
+        f"--command={remote}",
+    ]
+    project = args.tpu_project or getattr(config, "tpu_project", None)
+    if project:
+        cmd.append(f"--project={project}")
+    return cmd
+
+
+def tpu_config_command(args) -> int:
+    config = load_config_from_file(args.config_file)
+    if not (args.tpu_name or config.tpu_name):
+        print("No TPU name given (--tpu_name or config file)")
+        return 1
+    cmd = build_remote_command(args, config)
+    if args.debug:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    print(f"Running on all workers of {args.tpu_name or config.tpu_name}...")
+    return subprocess.run(cmd).returncode

@@ -140,6 +140,32 @@ class TestTrainingLoop:
         # 4 batches, accum 2 -> schedule advanced twice
         assert lrs == pytest.approx([0.1, 0.09, 0.09, 0.08])
 
+    def test_detached_scheduler_follows_manual_steps_and_warns_on_drift(self):
+        import warnings
+
+        accelerator = make_accelerator(step_scheduler_with_optimizer=False)
+        model = make_regression_model()
+        schedule = optax.linear_schedule(0.1, 0.0, 10)
+        optimizer = optax.sgd(schedule)
+        dl = DataLoader(RegressionDataset(length=32), batch_size=16)
+        model, optimizer, dl, scheduler = accelerator.prepare(model, optimizer, dl, schedule)
+        assert not scheduler.step_with_optimizer
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            # manual-step twice per optimizer step: counters diverge
+            for batch in dl:
+                out = model(batch["x"], batch["y"])
+                accelerator.backward(out["loss"])
+                optimizer.step()
+                scheduler.step()
+                scheduler.step()
+                optimizer.zero_grad()
+        # detached: reported lr follows the MANUAL count (4 steps), not the
+        # engine count (2 updates)
+        assert scheduler.last_step == 4
+        assert scheduler.get_last_lr()[0] == pytest.approx(float(schedule(4)))
+        assert any("manual steps" in str(w.message) for w in caught), [str(w.message) for w in caught]
+
     def test_eval_mode_no_grads(self):
         accelerator = make_accelerator()
         model = make_regression_model()

@@ -150,6 +150,43 @@ class TestNotebookLauncher:
         assert _time.monotonic() - start < 60
 
 
+class TestConfigUpdate:
+    def test_update_rewrites_with_current_schema(self, tmp_path):
+        cfg_path = tmp_path / "cfg.yaml"
+        r = _run(["config", "default", "--config_file", str(cfg_path)])
+        assert r.returncode == 0, r.stderr
+        # simulate an older config: drop a field, add a stale one
+        text = cfg_path.read_text()
+        text = "\n".join(l for l in text.splitlines() if not l.startswith("tensor_parallel"))
+        text += "\nsome_removed_option: true\n"
+        cfg_path.write_text(text)
+        r = _run(["config", "update", "--config_file", str(cfg_path)])
+        assert r.returncode == 0, r.stderr + r.stdout
+        updated = cfg_path.read_text()
+        assert "tensor_parallel" in updated  # new field restored with default
+        assert "some_removed_option" not in updated  # stale key dropped
+
+    def test_update_without_config_errors(self, tmp_path):
+        r = _run(["config", "update", "--config_file", str(tmp_path / "missing.yaml")])
+        assert r.returncode == 1
+
+
+class TestTpuConfig:
+    def test_debug_prints_gcloud_fanout(self):
+        r = _run([
+            "tpu-config", "--debug", "--tpu_name", "pod0", "--tpu_zone", "us-central2-b",
+            "--command", "echo hello", "--install_accelerate",
+        ])
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "gcloud" in r.stdout and "--worker=all" in r.stdout
+        assert "pip install" in r.stdout and "echo hello" in r.stdout
+
+    def test_requires_tpu_name(self, tmp_path):
+        r = _run(["tpu-config", "--command", "echo hi"],
+                 env_extra={"ACCELERATE_TPU_CONFIG_FILE": str(tmp_path / "none.yaml")})
+        assert r.returncode == 1
+
+
 class TestElasticLaunch:
     def test_max_restarts_recovers(self, tmp_path):
         script = tmp_path / "flaky.py"
