@@ -56,4 +56,12 @@ def __getattr__(name):
         from .utils.memory import find_executable_batch_size
 
         return find_executable_batch_size
+    if name in ("generate", "generate_dispatched"):
+        from . import generation
+
+        return getattr(generation, name)
+    if name in ("cpu_offload", "disk_offload", "cpu_offload_with_hook", "load_and_quantize_model"):
+        from . import big_modeling
+
+        return getattr(big_modeling, name)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

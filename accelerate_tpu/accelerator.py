@@ -208,6 +208,17 @@ class TrainEngine:
         self.param_sharding = infer_param_sharding(
             raw_params, self.mesh, self.sharding_config, logical_axes
         )
+        if self.sharding_config.offload_params_to_host:
+            # FSDP cpu_offload analog: master params live in pinned host;
+            # every compute path streams them to HBM in-graph (_cast_params).
+            # Scalar params stay on device (rank-0 placement rejected by SPMD).
+            from .parallel.sharding import with_memory_kind
+
+            self.param_sharding = jax.tree_util.tree_map(
+                lambda sh, p: with_memory_kind(sh, "pinned_host") if getattr(p, "ndim", 0) >= 1 else sh,
+                self.param_sharding,
+                raw_params,
+            )
         with jax.transfer_guard("allow"):
             self.params = shard_params(
                 jax.tree_util.tree_map(
@@ -260,6 +271,10 @@ class TrainEngine:
             return self.model.definition(params, *args, **kwargs), extra_state
 
     def _cast_params(self, params):
+        if self.sharding_config.offload_params_to_host:
+            from .parallel.sharding import transfer_tree
+
+            params = transfer_tree(params, jax.memory.Space.Device)
         c = self.precision.compute_dtype
         return jax.tree_util.tree_map(
             lambda p: p.astype(c) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
@@ -372,20 +387,72 @@ class TrainEngine:
     # ------------------------------------------------------------------
 
     def attach_optimizer(self, optimizer: optax.GradientTransformation, schedule=None):
-        from .parallel.sharding import infer_opt_state_sharding
+        from .parallel.sharding import (
+            infer_opt_state_sharding,
+            transfer_tree,
+            tree_with_memory_kind,
+        )
 
         self.optimizer = optimizer
         self.schedule = schedule
+        # opt shardings derive from the DEVICE view of the param shardings:
+        # memory kinds in a jit's out_shardings must be uniform per memory
+        # space or the SPMD partitioner rejects the rank-0 annotations
+        base_param_sharding = (
+            tree_with_memory_kind(self.param_sharding, "device")
+            if self.sharding_config.offload_params_to_host
+            else self.param_sharding
+        )
         self.opt_state_sharding = infer_opt_state_sharding(
-            optimizer, self.params, self.param_sharding, self.mesh
+            optimizer, self.params, base_param_sharding, self.mesh
         )
         init = self._get_jit(
-            "opt_init", lambda p: optimizer.init(p), out_shardings=self.opt_state_sharding
+            "opt_init",
+            lambda p: optimizer.init(transfer_tree(p, jax.memory.Space.Device)),
+            out_shardings=self.opt_state_sharding,
         )
         self.opt_state = init(self.params)
+        if self.sharding_config.offload_optimizer_state:
+            # ZeRO-offload analog: Adam moments (2x params in fp32 — usually
+            # the single biggest HBM line item) live in pinned host between
+            # steps; _update_fn streams them to HBM per update and the step
+            # wrappers re-place them host-side after. Scalar leaves (step
+            # counts) stay on device — the SPMD partitioner rejects
+            # placement annotations on rank-0 buffers.
+            from .parallel.sharding import with_memory_kind
+
+            self.opt_state_sharding = jax.tree_util.tree_map(
+                lambda sh, leaf: with_memory_kind(sh, "pinned_host") if getattr(leaf, "ndim", 0) >= 1 else sh,
+                self.opt_state_sharding,
+                self.opt_state,
+            )
+            self.opt_state = self._replace_offloaded_opt(self.opt_state)
+
+    def _replace_offloaded_opt(self, opt_state):
+        return jax.tree_util.tree_map(
+            lambda x, sh: jax.device_put(x, sh) if getattr(x, "ndim", 0) >= 1 else x,
+            opt_state,
+            self.opt_state_sharding,
+        )
+
+    def _replace_offloaded_params(self, params):
+        return jax.tree_util.tree_map(
+            lambda x, sh: jax.device_put(x, sh) if getattr(x, "ndim", 0) >= 1 else x,
+            params,
+            self.param_sharding,
+        )
 
     def _update_fn(self, params, opt_state, grads, scale_state, finite, max_norm):
-        """One optimizer update: clip -> optax -> apply; fp16 skip via cond."""
+        """One optimizer update: clip -> optax -> apply; fp16 skip via cond.
+        Host-offloaded state streams HBM-ward here and back at the end."""
+        from .parallel.sharding import transfer_tree
+
+        offload_opt = self.sharding_config.offload_optimizer_state
+        offload_p = self.sharding_config.offload_params_to_host
+        if offload_opt:
+            opt_state = transfer_tree(opt_state, jax.memory.Space.Device)
+        if offload_p:
+            params = transfer_tree(params, jax.memory.Space.Device)
         if max_norm is not None:
             gnorm = optax.global_norm(grads)
             clip_scale = jnp.minimum(1.0, max_norm / (gnorm + 1e-6))
@@ -454,6 +521,10 @@ class TrainEngine:
         if use_clip:
             call_args.append(jnp.asarray(max_norm, jnp.float32))
         new_params, new_opt, new_scale, skipped = self._jit_cache[key](*call_args)
+        if self.sharding_config.offload_params_to_host:
+            new_params = self._replace_offloaded_params(new_params)
+        if self.sharding_config.offload_optimizer_state:
+            new_opt = self._replace_offloaded_opt(new_opt)
         self.params = new_params
         self.opt_state = new_opt
         if self.scale_state is not None:
@@ -620,6 +691,10 @@ class TrainEngine:
             new_params, new_opt, new_extra, new_scale, skipped, metrics = jitted(
                 self.params, self.opt_state, self.extra_state, self.scale_state, rng_key, batch
             )
+            if self.sharding_config.offload_params_to_host:
+                new_params = self._replace_offloaded_params(new_params)
+            if self.sharding_config.offload_optimizer_state:
+                new_opt = self._replace_offloaded_opt(new_opt)
             self.params, self.opt_state = new_params, new_opt
             self.extra_state = new_extra
             if self.scale_state is not None:

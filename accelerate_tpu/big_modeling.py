@@ -165,29 +165,14 @@ class DispatchedModel:
         traced_kw = {k: v for k, v in kwargs.items() if not is_static(v)}
         static_kw = tuple(sorted((k, v) for k, v in kwargs.items() if is_static(v)))
         if self._jit is None:
-            shardings = self._target_shardings()
-            stream = self._STREAM
-
-            def _place(leaf, sh):
-                if isinstance(sh, str):
-                    if sh == stream:
-                        return leaf  # the model streams this subtree per-layer
-                    return jax.device_put(leaf, jax.memory.Space.Device)
-                return jax.device_put(leaf, sh)
+            placer = self.param_placer()
 
             def apply(p, a, kw, s_args, s_kw):
-                from .utils.quantization import dequantize_params
-
                 a = list(a)
                 for i, v in s_args:
                     a[i] = v
                 kw = dict(kw, **dict(s_kw))
-                p = jax.tree_util.tree_map(_place, p, shardings)
-                # int8/int4 weights dequantize in-graph here; XLA fuses the
-                # (data * scale) into the consuming matmul, so the resident
-                # form stays quantized
-                p = dequantize_params(p)
-                return self.definition.apply({"params": p}, *a, **kw)
+                return self.definition.apply({"params": placer(p)}, *a, **kw)
 
             self._apply = apply
             self._jit = jax.jit(apply, static_argnums=(3, 4))
@@ -196,6 +181,30 @@ class DispatchedModel:
         except TypeError:
             return self._apply(params, traced_args, traced_kw, static_args, static_kw)
         return self._jit(params, traced_args, traced_kw, static_args, static_kw)
+
+    def param_placer(self):
+        """In-graph placement transform used by this model's jit (and by
+        generation): device-tier leaves pin to their sharding, non-streamable
+        host leaves transfer at the jit boundary, streamable subtrees stay in
+        pinned host for the model's per-layer streaming, and quantized
+        weights dequantize in-graph (fused into consumers)."""
+        from .utils.quantization import dequantize_params
+
+        shardings = self._target_shardings()
+        stream = self._STREAM
+
+        def _place(leaf, sh):
+            if isinstance(sh, str):
+                if sh == stream:
+                    return leaf
+                return jax.device_put(leaf, jax.memory.Space.Device)
+            return jax.device_put(leaf, sh)
+
+        def placer(p):
+            p = jax.tree_util.tree_map(_place, p, shardings)
+            return dequantize_params(p)
+
+        return placer
 
     def materialize(self):
         """Force all params into device memory (drops offload tiers)."""

@@ -41,6 +41,8 @@ class DecoderConfig:
     # params + GPipe microbatch schedule (parallel/pipeline.py)
     pipeline_stages: int = 1
     pipeline_microbatches: Optional[int] = None  # None -> pipeline_stages
+    # KV-cache length for generation (None -> max_seq_len)
+    max_cache_len: Optional[int] = None
     # fp8 recipe (ops/fp8.py): MLP contractions run e4m3-fwd/e5m2-bwd with
     # current scaling. Flipped on by Accelerator(mixed_precision="fp8").
     use_fp8: bool = False

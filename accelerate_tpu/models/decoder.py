@@ -59,13 +59,23 @@ def _maybe_streaming(body, cfg):
 
 
 class DecoderAttention(nn.Module):
+    """``use_cache`` turns on the KV cache (a mutable "cache" collection):
+    the prefill pass (decode=False) writes the prompt's K/V at [0:s] and
+    attends causally on the flash path; each decode step (decode=True, s==1)
+    appends at the running index and attends against the cache prefix. The
+    cache is [B, KVH, max_cache_len, D] — static shapes, so the whole decode
+    loop compiles once."""
+
     config: DecoderConfig
     mesh: Optional[Mesh] = None
+    use_cache: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, sin, cos, deterministic: bool = True):
         cfg = self.config
         e, h, kv, d = cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        b, s = x.shape[0], x.shape[1]
         wq = self.param("wq", nn.with_logical_partitioning(_dense_init(), ("embed", "heads", "head_dim")), (e, h, d))
         wk = self.param("wk", nn.with_logical_partitioning(_dense_init(), ("embed", "kv_heads", "head_dim")), (e, kv, d))
         wv = self.param("wv", nn.with_logical_partitioning(_dense_init(), ("embed", "kv_heads", "head_dim")), (e, kv, d))
@@ -79,7 +89,34 @@ class DecoderAttention(nn.Module):
         k = _constrain(k, ("batch", "kv_heads", "seq", "head_dim"), self.mesh)
         q = apply_rotary_embedding(q, sin, cos)
         k = apply_rotary_embedding(k, sin, cos)
-        if self.mesh is not None and self.mesh.shape.get("sequence", 1) > 1:
+
+        if self.use_cache:
+            max_len = cfg.max_cache_len or cfg.max_seq_len
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, (b, kv, max_len, d), k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, (b, kv, max_len, d), v.dtype)
+            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+            cur = cache_index.value
+            if not self.decode:
+                # prefill: cache starts at 0, so plain causal attention over
+                # the freshly computed K/V stays on the flash-kernel path
+                cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, 0, 0))
+                cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, 0, 0))
+                cache_index.value = jnp.asarray(s, jnp.int32)
+                out = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+            else:
+                k_full = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
+                v_full = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
+                cached_k.value = k_full
+                cached_v.value = v_full
+                cache_index.value = cur + s
+                # query i sits at global position cur+i; valid kv = [0, cur+i]
+                q_pos = cur + jnp.arange(s)
+                kv_pos = jnp.arange(max_len)
+                from ..ops.attention import NEG_INF
+
+                bias = jnp.where(kv_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF)[None, None]
+                out = dot_product_attention(q, k_full, v_full, causal=False, bias=bias)
+        elif self.mesh is not None and self.mesh.shape.get("sequence", 1) > 1:
             from ..parallel.context import ring_attention_sharded
 
             out = ring_attention_sharded(q, k, v, self.mesh, causal=True)
@@ -116,6 +153,8 @@ class DecoderBlock(nn.Module):
 
     config: DecoderConfig
     mesh: Optional[Mesh] = None
+    use_cache: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, sin, cos, deterministic: bool = True):
@@ -123,7 +162,7 @@ class DecoderBlock(nn.Module):
         ln1 = self.param("ln_attn", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         ln2 = self.param("ln_mlp", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         y = rms_norm(x, ln1, cfg.norm_eps)
-        y = DecoderAttention(cfg, self.mesh, name="attn")(y, sin, cos, deterministic)
+        y = DecoderAttention(cfg, self.mesh, self.use_cache, self.decode, name="attn")(y, sin, cos, deterministic)
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
         x = x + y
@@ -145,11 +184,15 @@ class _ScanBlock(nn.Module):
 
     config: DecoderConfig
     mesh: Optional[Mesh] = None
+    use_cache: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
         x, aux, sin, cos, deterministic = carry
-        x, block_aux = DecoderBlock(self.config, self.mesh, name="block")(x, sin, cos, deterministic)
+        x, block_aux = DecoderBlock(self.config, self.mesh, self.use_cache, self.decode, name="block")(
+            x, sin, cos, deterministic
+        )
         return (x, aux + block_aux, sin, cos, deterministic), None
 
 
@@ -196,9 +239,15 @@ class DecoderLM(nn.Module):
         labels: Optional[jax.Array] = None,
         positions: Optional[jax.Array] = None,
         deterministic: bool = True,
+        use_cache: bool = False,
+        decode: bool = False,
     ):
         cfg = self.config
         b, s = input_ids.shape
+        if use_cache and self._effective_stages() > 1:
+            raise NotImplementedError("KV-cache generation is not wired through the pipeline schedule")
+        if use_cache and cfg.remat:
+            raise ValueError("generation needs remat=False (mutable KV cache under jax.checkpoint)")
         embedding = self.param(
             "embedding",
             nn.with_logical_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
@@ -261,12 +310,12 @@ class DecoderLM(nn.Module):
                 )
             ScanStack = nn.scan(
                 scan_body,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layer"},
             )
-            (x, moe_aux, _, _, _), _ = ScanStack(cfg, self.mesh, name="layers")(
+            (x, moe_aux, _, _, _), _ = ScanStack(cfg, self.mesh, use_cache, decode, name="layers")(
                 (x, jnp.float32(0.0), sin, cos, deterministic), None
             )
         else:
@@ -274,7 +323,9 @@ class DecoderLM(nn.Module):
             if cfg.remat:
                 block_cls = nn.remat(block_cls, prevent_cse=True)
             for i in range(cfg.num_layers):
-                x, block_aux = block_cls(cfg, self.mesh, name=f"layer_{i}")(x, sin, cos, deterministic)
+                x, block_aux = block_cls(cfg, self.mesh, use_cache, decode, name=f"layer_{i}")(
+                    x, sin, cos, deterministic
+                )
                 moe_aux = moe_aux + block_aux
 
         ln_f = self.param("ln_final", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))

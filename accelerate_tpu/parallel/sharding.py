@@ -136,6 +136,31 @@ def shard_params(params, shardings):
     )
 
 
+def with_memory_kind(sharding, kind: str):
+    """The same sharding in another memory space (host-offload plumbing)."""
+    from jax.sharding import SingleDeviceSharding
+
+    if isinstance(sharding, NamedSharding):
+        return NamedSharding(sharding.mesh, sharding.spec, memory_kind=kind)
+    if isinstance(sharding, SingleDeviceSharding):
+        return SingleDeviceSharding(next(iter(sharding.device_set)), memory_kind=kind)
+    return sharding
+
+
+def tree_with_memory_kind(shardings, kind: str):
+    return jax.tree_util.tree_map(lambda s: with_memory_kind(s, kind), shardings)
+
+
+def transfer_tree(tree, space):
+    """In-graph transfer of array leaves to a jax.memory.Space (call inside
+    jit; XLA's latency-hiding scheduler places the copies). Scalars stay put
+    — the SPMD partitioner rejects placement annotations on rank-0 buffers,
+    and offloading a scalar saves nothing."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, space) if getattr(x, "ndim", 0) >= 1 else x, tree
+    )
+
+
 def infer_opt_state_sharding(optimizer, params, param_sharding, mesh: Mesh):
     """Deterministic shardings for an optax state pytree (the ZeRO
     optimizer-state-sharding analog, reference DeepSpeedPlugin zero stages):

@@ -302,7 +302,8 @@ class ShardingConfig:
     axis_rules: Optional[tuple] = None
     # FSDP-detail parity knobs
     min_weight_size_to_shard: int = 2**18  # don't shard tiny params (biases, norms)
-    offload_params_to_host: bool = False   # ≙ FSDP cpu_offload: pinned_host memory kind
+    offload_params_to_host: bool = False   # ≙ FSDP cpu_offload: params live in pinned_host, stream per step
+    offload_optimizer_state: bool = False  # ≙ ZeRO-offload: Adam moments live in pinned_host
     remat_policy: Optional[str] = None     # "full" | "nothing_saveable" | "dots_saveable" | None
     use_shard_map: bool = False            # escape hatch: explicit shard_map instead of GSPMD
 

@@ -118,6 +118,28 @@ def _ttft_bench(cfg, prompt_len, tmpdir):
     return ttft
 
 
+def _decode_bench(cfg, prompt_len, new_tokens):
+    """Greedy generation s/token on device-resident weights (the BASELINE
+    big-model-inference table's generation metric)."""
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+
+    model_def = DecoderLM(cfg)
+    variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len)
+    params, _ = unbox_params(variables["params"])
+    params = jax.device_put(params)
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (1, prompt_len))
+
+    out = generate(model_def, params, ids, max_new_tokens=new_tokens)  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = generate(model_def, params, ids, max_new_tokens=new_tokens)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return dt / new_tokens
+
+
 def main():
     import argparse
 
@@ -169,13 +191,14 @@ def main():
 
         import tempfile
 
+        ttft_cfg = DecoderConfig(
+            vocab_size=32_000, num_layers=12, embed_dim=1536, num_heads=12,
+            num_kv_heads=12, mlp_dim=4096, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=False, scan_layers=True,
+        )
         with tempfile.TemporaryDirectory() as td:
-            ttft_cfg = DecoderConfig(
-                vocab_size=32_000, num_layers=12, embed_dim=1536, num_heads=12,
-                num_kv_heads=12, mlp_dim=4096, max_seq_len=2048,
-                dtype=jnp.bfloat16, remat=False, scan_layers=True,
-            )
             extra["dispatch_ttft_s"] = round(_ttft_bench(ttft_cfg, 128, td), 2)
+        extra["decode_ms_per_token"] = round(_decode_bench(ttft_cfg, 128, 64) * 1e3, 2)
     else:
         cfg = DecoderConfig.tiny(max_seq_len=256)
         tok_s, mfu, _, step_ms = _train_bench(cfg, 4, 128, 5, "no")
@@ -183,6 +206,9 @@ def main():
 
         with tempfile.TemporaryDirectory() as td:
             extra["dispatch_ttft_s"] = round(_ttft_bench(DecoderConfig.tiny(), 32, td), 2)
+        extra["decode_ms_per_token"] = round(
+            _decode_bench(DecoderConfig.tiny(max_seq_len=128), 32, 16) * 1e3, 2
+        )
 
     print(
         f"[bench] backend={jax.default_backend()} tokens/s={tok_s:,.0f} "

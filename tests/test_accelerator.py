@@ -320,6 +320,71 @@ class TestCheckpointing:
         assert (tmp_path / "weights" / "model.safetensors").exists()
 
 
+class TestHostOffload:
+    """ZeRO-offload / FSDP-cpu_offload analogs: optimizer state (and
+    optionally master params) live in pinned host memory between steps."""
+
+    def _train(self, **sc_kwargs):
+        from accelerate_tpu import Model
+        from accelerate_tpu.models import DecoderConfig, DecoderLM
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        accelerator = make_accelerator(sharding_config=ShardingConfig(**sc_kwargs))
+        cfg = DecoderConfig.tiny()
+        model_def = DecoderLM(cfg)
+        variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=2, seq_len=32)
+        model, optimizer = accelerator.prepare(Model(model_def, variables), optax.adam(1e-2))
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 32))
+        batch = accelerator.prepare_for_eval({"input_ids": ids, "labels": ids})
+        step = accelerator.build_train_step()
+        losses = [float(jax.device_get(step(batch)["loss"])) for _ in range(8)]
+        return accelerator, model, losses
+
+    def test_optimizer_state_offload_trains(self):
+        accelerator, model, losses = self._train(offload_optimizer_state=True)
+        assert losses[-1] < losses[0], losses
+        kinds = {
+            getattr(l.sharding, "memory_kind", None)
+            for l in jax.tree_util.tree_leaves(model._engine.opt_state)
+            if hasattr(l, "sharding") and getattr(l, "ndim", 0) >= 1
+        }
+        assert "pinned_host" in kinds, kinds
+
+    def test_param_offload_trains(self):
+        accelerator, model, losses = self._train(offload_params_to_host=True)
+        assert losses[-1] < losses[0], losses
+        kinds = {
+            getattr(l.sharding, "memory_kind", None)
+            for l in jax.tree_util.tree_leaves(model._engine.params)
+            if hasattr(l, "sharding") and getattr(l, "ndim", 0) >= 1
+        }
+        assert "pinned_host" in kinds, kinds
+
+    def test_both_offloads_with_imperative_loop(self):
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        accelerator = make_accelerator(
+            sharding_config=ShardingConfig(offload_optimizer_state=True, offload_params_to_host=True)
+        )
+        model = make_regression_model()
+        model, optimizer = accelerator.prepare(model, optax.sgd(0.05))
+        ds = RegressionDataset(length=32, seed=2)
+        batch = accelerator.prepare_for_eval(
+            {"x": np.asarray(ds.x, np.float32), "y": np.asarray(ds.y, np.float32)}
+        )
+        first = last = None
+        for _ in range(10):
+            out = model(batch["x"], batch["y"])
+            accelerator.backward(out["loss"])
+            optimizer.step()
+            optimizer.zero_grad()
+            last = float(jax.device_get(out["loss"]))
+            first = first if first is not None else last
+        assert last < first, (first, last)
+
+
 class TestShardedCheckpointing:
     """FSDP-sharded save_state writes per-rank shard files straight from
     device (VERDICT r1: never materialize the full tree on one host)."""
