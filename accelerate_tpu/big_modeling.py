@@ -150,28 +150,19 @@ class DispatchedModel:
         )
 
     def __call__(self, *args, **kwargs):
-        params = self._concrete(self.params)
-        # bools / strings / None feed Python control flow inside apply (flax's
-        # `deterministic`, mode switches) and would hit
-        # ConcretizationTypeError as tracers, so they go in static; numbers,
-        # arrays, and containers stay traced exactly as before (making
-        # containers static would silently disable jit, and making scalars
-        # static would recompile per value).
-        import enum
+        # bool/str/None inputs go in as jit statics (Python control flow in
+        # flax modules); same partition the TrainEngine uses.
+        from .accelerator import _split_static_call
 
-        is_static = lambda v: isinstance(v, (bool, str, bytes, enum.Enum)) or v is None
-        traced_args = tuple(None if is_static(a) else a for a in args)
-        static_args = tuple((i, a) for i, a in enumerate(args) if is_static(a))
-        traced_kw = {k: v for k, v in kwargs.items() if not is_static(v)}
-        static_kw = tuple(sorted((k, v) for k, v in kwargs.items() if is_static(v)))
+        params = self._concrete(self.params)
+        traced_args, static_args, traced_kw, static_kw = _split_static_call(args, kwargs)
         if self._jit is None:
+            from .accelerator import _merge_static_call
+
             placer = self.param_placer()
 
             def apply(p, a, kw, s_args, s_kw):
-                a = list(a)
-                for i, v in s_args:
-                    a[i] = v
-                kw = dict(kw, **dict(s_kw))
+                a, kw = _merge_static_call(a, kw, s_args, s_kw)
                 return self.definition.apply({"params": placer(p)}, *a, **kw)
 
             self._apply = apply
@@ -207,7 +198,11 @@ class DispatchedModel:
         return placer
 
     def materialize(self):
-        """Force all params into device memory (drops offload tiers)."""
+        """Force all params into device memory (drops offload tiers).
+        No-op when already fully on device — a hooked pipeline calls this
+        every forward and must not retrace each time."""
+        if self.device_map == {"": "device"}:
+            return self
         params = self._concrete(self.params)
         shardings = self._target_shardings(all_device=True)
         params = jax.tree_util.tree_map(jax.device_put, params, shardings)
@@ -219,6 +214,8 @@ class DispatchedModel:
     def offload(self):
         """Demote every param back to pinned host memory (the inverse of
         materialize; the CpuOffloadHook mechanism below relies on it)."""
+        if self.device_map == {"": "cpu"}:
+            return self
         params = self._concrete(self.params)
         self.params = jax.tree_util.tree_map(
             lambda p: _to_pinned_host(np.asarray(jax.device_get(p))), params

@@ -77,13 +77,13 @@ def save_accelerator_state(
 
             save_pytree_dist(
                 model_tree, os.path.join(output_dir, f"{MODEL_NAME}_{i}"),
-                process_index=state.process_index,
+                process_index=state.process_index, num_processes=state.num_processes,
             )
             logger.info(f"Model weights saved sharded in {output_dir}/{MODEL_NAME}_{i}.rank*")
             if opt_flat is not None:
                 save_pytree_dist(
                     opt_flat, os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}"),
-                    process_index=state.process_index,
+                    process_index=state.process_index, num_processes=state.num_processes,
                 )
                 logger.info(f"Optimizer state saved sharded in {output_dir}/{OPTIMIZER_NAME}_{i}.rank*")
         else:

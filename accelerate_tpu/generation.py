@@ -38,8 +38,18 @@ def _sample(logits, key, temperature: float, top_k: Optional[int]):
 
 # jitted decode loops cached per (definition identity, loop shape): flax
 # modules/configs are unhashable, so the definition is closed over instead of
-# passed as a jit static, and reuse across generate() calls avoids recompiles
+# passed as a jit static, and reuse across generate() calls avoids recompiles.
+# Bounded FIFO: a long-lived server varying models/loop shapes must not pin
+# compiled programs (and their captured definitions/placers) forever.
 _LOOP_CACHE: dict = {}
+_LOOP_CACHE_LIMIT = 32
+
+
+def _cache_put(key, value):
+    if len(_LOOP_CACHE) >= _LOOP_CACHE_LIMIT:
+        _LOOP_CACHE.pop(next(iter(_LOOP_CACHE)))
+    _LOOP_CACHE[key] = value
+    return value
 
 
 def _decode_loop_for(definition, max_new_tokens, temperature, top_k, placer):
@@ -70,8 +80,7 @@ def _decode_loop_for(definition, max_new_tokens, temperature, top_k, placer):
         )
         return tokens.T  # [B, new_tokens]
 
-    _LOOP_CACHE[key] = loop
-    return loop
+    return _cache_put(key, loop)
 
 
 def generate(
@@ -139,8 +148,7 @@ def _prefill_for(definition, temperature, top_k, placer):
         last = _sample(out["logits"][:, -1], rng, temperature, top_k)
         return last, mutated["cache"]
 
-    _LOOP_CACHE[key] = prefill
-    return prefill
+    return _cache_put(key, prefill)
 
 
 def generate_dispatched(dispatched, input_ids, **kwargs):

@@ -38,17 +38,33 @@ class PipelinedModel:
         self.params = params
         self.num_microbatches = num_microbatches
         self._jit = jax.jit(
-            lambda p, ids: model_def.apply({"params": p}, ids)["logits"]
+            lambda p, ids, kw, s_kw: model_def.apply(
+                {"params": p}, ids, **dict(kw, **dict(s_kw))
+            )["logits"],
+            static_argnums=(3,),
         )
 
     def __call__(self, input_ids, **kwargs):
+        from .accelerator import _split_static_call
+
         ids = jnp.asarray(input_ids)
         batch = ids.shape[0]
         target = -(-batch // self.num_microbatches) * self.num_microbatches
         if target != batch:
             pad = jnp.tile(ids[:1], (target - batch,) + (1,) * (ids.ndim - 1))
             ids = jnp.concatenate([ids, pad], axis=0)
-        logits = self._jit(self.params, ids)
+            # batch-dim kwargs (e.g. attention masks) must pad with the batch
+            kwargs = {
+                k: jnp.concatenate(
+                    [jnp.asarray(v), jnp.tile(jnp.asarray(v)[:1], (target - batch,) + (1,) * (jnp.asarray(v).ndim - 1))],
+                    axis=0,
+                )
+                if hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1 and jnp.asarray(v).shape[0] == batch
+                else v
+                for k, v in kwargs.items()
+            }
+        _, _, traced_kw, static_kw = _split_static_call((), kwargs)
+        logits = self._jit(self.params, ids, traced_kw, static_kw)
         return logits[:batch]
 
     def eval(self):

@@ -288,8 +288,19 @@ class DecoderLM(nn.Module):
             # M only affects the schedule (params are per-stage, not per-M):
             # adapt it down to the largest count dividing this batch so odd
             # batches (init's batch_size=1, ragged eval) still trace.
+            configured_micro = num_micro
             while b % num_micro != 0:
                 num_micro -= 1
+            if num_micro != configured_micro and b > 1:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pipeline: batch %d is not divisible by the configured "
+                    "%d microbatches; running with M=%d — at M < num_stages "
+                    "the GPipe bubble dominates. Pick a batch size divisible "
+                    "by pipeline_microbatches.",
+                    b, configured_micro, num_micro,
+                )
             x_mb = split_microbatches(x, num_micro)
             x = PipelineStages(
                 stage_module=StageStack,

@@ -94,7 +94,9 @@ def quantize_array(w, bits: int = 8, group_size: int = 128) -> QuantizedWeight:
     q = q.reshape(w.shape)
     scale = scale[:, 0]  # [K/g, ...]
     if bits == 4:
-        # pack two consecutive-K nibbles per byte: [K, ...] -> [K/2, ...]
+        # pack two consecutive-K nibbles per byte: [K, ...] -> [ceil(K/2), ...]
+        if k % 2:  # odd K: pad one zero row so the nibble pairs line up
+            q = jnp.concatenate([q, jnp.zeros((1,) + q.shape[1:], q.dtype)], axis=0)
         lo = q[0::2] & 0x0F
         hi = (q[1::2] & 0x0F) << 4
         q = (lo | hi).astype(jnp.int8)
@@ -108,7 +110,8 @@ def dequantize_array(qw: QuantizedWeight):
         lo = (data << 4).astype(jnp.int8) >> 4  # sign-extend low nibble
         hi = data >> 4  # arithmetic shift sign-extends the high nibble
         k = qw.shape[0]
-        data = jnp.stack([lo, hi], axis=1).reshape(k, *qw.shape[1:])
+        data = jnp.stack([lo, hi], axis=1).reshape(2 * data.shape[0], *qw.shape[1:])
+        data = data[:k]  # drop the pad row when K was odd
     k, g = qw.shape[0], qw.group
     w = data.astype(jnp.float32).reshape(k // g, g, *qw.shape[1:])
     w = w * qw.scale[:, None]

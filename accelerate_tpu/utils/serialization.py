@@ -181,7 +181,8 @@ def write_safetensors_streaming(path: str, entries, metadata: dict | None = None
     return path
 
 
-def save_pytree_dist(tree, base: str | os.PathLike, process_index: int = 0) -> list[str]:
+def save_pytree_dist(tree, base: str | os.PathLike, process_index: int = 0,
+                     num_processes: int | None = None) -> list[str]:
     """Per-rank sharded save. Writes ``<base>.rank<r>.safetensors`` with this
     process's unique shards plus ``<base>.rank<r>.manifest.json`` describing
     each chunk's place in the global array. Every process must call this
@@ -189,9 +190,15 @@ def save_pytree_dist(tree, base: str | os.PathLike, process_index: int = 0) -> l
     exactly once across the job). Non-array leaves and numpy leaves are
     written by process 0 only."""
     base = str(base)
+    if num_processes is None:
+        num_processes = jax.process_count()
     flat = flatten_pytree(tree)
     entries = []  # for write_safetensors_streaming
-    manifest: dict = {"format": "att_dist_v1", "tensors": {}}
+    manifest: dict = {
+        "format": "att_dist_v1",
+        "num_processes": int(num_processes),
+        "tensors": {},
+    }
     fname = f"{base}.rank{process_index}.safetensors"
 
     def _record(key, global_shape, dtype, start, shape, fetch):
@@ -241,7 +248,12 @@ def _find_dist_manifests(base: str) -> list[str]:
 
 def _load_dist(base: str) -> dict[str, np.ndarray]:
     """Reassemble a per-rank sharded checkpoint. Peak host memory: the
-    assembled tensors plus one rank file's shard at a time."""
+    assembled tensors plus one rank file's shard at a time.
+
+    Completeness is verified before returning: every rank manifest the save
+    recorded must be present, and each tensor's chunks must tile its full
+    global volume — a partially written checkpoint (a host died mid-save)
+    raises instead of silently yielding uninitialized weight regions."""
     import ml_dtypes
 
     manifests = _find_dist_manifests(base)
@@ -249,18 +261,40 @@ def _load_dist(base: str) -> dict[str, np.ndarray]:
         raise FileNotFoundError(f"no .rank*.manifest.json next to {base}")
     folder = os.path.dirname(base) or "."
     out: dict[str, np.ndarray] = {}
+    covered: dict[str, int] = {}
     code_to_np = dict(_SAFETENSORS_DTYPES)
     code_to_np["BF16"] = ml_dtypes.bfloat16
     # group chunk reads per rank file so each file is opened/parsed once
     per_file: dict[str, list] = {}
+    expected_ranks = None
     for mpath in manifests:
         with open(mpath) as f:
             man = json.load(f)
+        n = man.get("num_processes")
+        if n is not None:
+            expected_ranks = max(expected_ranks or 0, int(n))
         for key, info in man["tensors"].items():
             if key not in out:
                 out[key] = np.empty(tuple(info["shape"]), dtype=code_to_np[info["dtype"]])
+                covered[key] = 0
             for ck in info["chunks"]:
                 per_file.setdefault(os.path.join(folder, ck["file"]), []).append((key, ck))
+                covered[key] += int(np.prod(ck["shape"])) if ck["shape"] else 1
+    if expected_ranks is not None and len(manifests) < expected_ranks:
+        raise ValueError(
+            f"distributed checkpoint {base} is incomplete: {len(manifests)} rank "
+            f"manifest(s) found but the save recorded {expected_ranks} processes"
+        )
+    bad = {
+        k: (covered[k], int(np.prod(out[k].shape)) if out[k].shape else 1)
+        for k in out
+        if covered[k] != (int(np.prod(out[k].shape)) if out[k].shape else 1)
+    }
+    if bad:
+        raise ValueError(
+            f"distributed checkpoint {base} is incomplete: chunk volume does not "
+            f"tile the global shape for {list(bad)[:5]} (have/need = {list(bad.values())[:5]})"
+        )
     for fpath, refs in per_file.items():
         data = _load_safetensors(fpath)
         for key, ck in refs:

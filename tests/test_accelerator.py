@@ -456,6 +456,42 @@ class TestShardedCheckpointing:
                 merged["params/" + k], np.asarray(jax.device_get(v)), err_msg=k
             )
 
+    def test_incomplete_dist_checkpoint_raises(self, tmp_path):
+        """A checkpoint missing a rank's files must raise, not hand back
+        uninitialized weight regions."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from accelerate_tpu.parallel.mesh import build_mesh
+        from accelerate_tpu.utils.serialization import load_flat_dict, save_pytree_dist
+
+        mesh = build_mesh({"replica": 1, "stage": 1, "data": 1, "fsdp": 8,
+                           "expert": 1, "sequence": 1, "tensor": 1})
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        sharded = jax.device_put(x, NamedSharding(mesh, P("fsdp")))
+        save_pytree_dist({"w": sharded}, str(tmp_path / "t"), process_index=0, num_processes=2)
+        # rank 1 "died": only rank 0's manifest exists, claiming 2 processes
+        with pytest.raises(ValueError, match="incomplete"):
+            load_flat_dict(str(tmp_path / "t"))
+
+    def test_dist_chunk_volume_mismatch_raises(self, tmp_path):
+        import json as _json
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from accelerate_tpu.parallel.mesh import build_mesh
+        from accelerate_tpu.utils.serialization import load_flat_dict, save_pytree_dist
+
+        mesh = build_mesh({"replica": 1, "stage": 1, "data": 2, "fsdp": 4,
+                           "expert": 1, "sequence": 1, "tensor": 1})
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        sharded = jax.device_put(x, NamedSharding(mesh, P("fsdp")))
+        save_pytree_dist({"w": sharded}, str(tmp_path / "t"))
+        # corrupt: drop a chunk from the manifest
+        mpath = tmp_path / "t.rank0.manifest.json"
+        man = _json.loads(mpath.read_text())
+        man["tensors"]["w"]["chunks"] = man["tensors"]["w"]["chunks"][:-1]
+        mpath.write_text(_json.dumps(man))
+        with pytest.raises(ValueError, match="incomplete"):
+            load_flat_dict(str(tmp_path / "t"))
+
     def test_dist_roundtrip_serialization_level(self, tmp_path):
         from jax.sharding import NamedSharding, PartitionSpec as P
         from accelerate_tpu.parallel.mesh import build_mesh

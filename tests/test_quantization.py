@@ -76,6 +76,15 @@ class TestWeightOnlyQuant:
         step_bound = float(jnp.max(jnp.abs(w))) / qmax
         assert float(jnp.max(jnp.abs(back - w))) <= step_bound
 
+    def test_int4_odd_k_roundtrips(self):
+        w = jax.random.normal(jax.random.PRNGKey(5), (129, 16))
+        qw = quantize_array(w, bits=4, group_size=0)  # group = full K
+        back = dequantize_array(qw)
+        assert back.shape == w.shape
+        qmax = 7
+        step_bound = float(jnp.max(jnp.abs(w))) / qmax
+        assert float(jnp.max(jnp.abs(back - w))) <= step_bound
+
     def test_int4_packs_two_per_byte(self):
         w = jax.random.normal(jax.random.PRNGKey(1), (128, 32))
         q8 = quantize_array(w, bits=8)
