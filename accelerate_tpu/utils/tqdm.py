@@ -1,0 +1,26 @@
+"""Main-process-gated tqdm (parity: reference utils/tqdm.py).
+
+In a multi-host job every process iterating the same loader would print its
+own progress bar; this wrapper renders only on the main process (or only on
+each local main with ``local=True``) and is a transparent passthrough when
+tqdm isn't installed.
+"""
+
+from __future__ import annotations
+
+
+def tqdm(*args, main_process_only: bool = True, local: bool = False, **kwargs):
+    """Drop-in ``tqdm.auto.tqdm`` that stays silent off the main process."""
+    from ..state import PartialState
+
+    try:
+        from tqdm.auto import tqdm as _tqdm
+    except ImportError:  # pragma: no cover - tqdm absent: plain passthrough
+        iterable = args[0] if args else kwargs.get("iterable")
+        return iter(iterable) if iterable is not None else iter(())
+
+    if main_process_only:
+        state = PartialState()
+        show = state.is_local_main_process if local else state.is_main_process
+        kwargs.setdefault("disable", not show)
+    return _tqdm(*args, **kwargs)
