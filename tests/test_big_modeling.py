@@ -162,6 +162,44 @@ class TestModelingUtils:
         assert placement_of("emb/w", dm) == "device"
         assert placement_of("head/w", dm) == "device", dm  # rides along free
 
+    def test_device_map_invariants_random_trees(self):
+        """Property check over random module trees and budgets: every param
+        covered exactly once, per-tier byte budgets never exceeded, and
+        module order never moves to a faster tier after a spill."""
+        rng = np.random.RandomState(7)
+        for trial in range(25):
+            tree = {}
+            for m in range(rng.randint(2, 6)):
+                mod = {}
+                for p in range(rng.randint(1, 5)):
+                    mod[f"w{p}"] = np.zeros((int(rng.randint(1, 200)),), np.float32)
+                tree[f"m{m:02d}"] = mod
+            total = compute_module_sizes(tree)[""]
+            dev_budget = int(rng.randint(1, max(total, 2)))
+            cpu_budget = int(rng.randint(1, max(total, 2)))
+            try:
+                dm = infer_auto_device_map(
+                    tree,
+                    max_memory={"device": dev_budget, "cpu": cpu_budget, "disk": 1 << 62},
+                    mode="sequential",
+                )
+            except ValueError:
+                continue  # nothing fit — acceptable outcome
+            from accelerate_tpu.utils.serialization import flatten_pytree
+
+            used = {"device": 0, "cpu": 0, "disk": 0}
+            tier_rank = {"device": 0, "cpu": 1, "disk": 2}
+            last_rank = 0
+            for path, leaf in flatten_pytree(tree).items():
+                tier = placement_of(path, dm)
+                used[tier] += leaf.nbytes
+                # module-order monotonicity (paths iterate in insertion order)
+                assert tier_rank[tier] >= last_rank, (trial, dm)
+                last_rank = tier_rank[tier]
+            assert used["device"] <= dev_budget, (trial, used, dev_budget, dm)
+            assert used["cpu"] <= cpu_budget, (trial, used, cpu_budget, dm)
+            assert sum(used.values()) == total
+
     def test_device_map_modes(self):
         model, _ = _tiny_model()
         abstract = init_empty_weights(model, jnp.zeros((1, 8), jnp.int32))["params"]
