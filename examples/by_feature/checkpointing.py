@@ -1,0 +1,104 @@
+"""By-feature example: checkpointing with automatic naming and resume.
+
+Mirrors the reference feature example (/root/reference/examples/by_feature/
+checkpointing.py): ProjectConfiguration(automatic_checkpoint_naming=True,
+total_limit=N) rotates `checkpoints/checkpoint_<i>` dirs under project_dir,
+and --resume_from_checkpoint restores everything (model, optimizer, RNG,
+step counters).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model, ProjectConfiguration
+from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+from accelerate_tpu.utils.random import set_seed
+
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+def training_function(config, args):
+    # New for this feature: automatic checkpoint rotation under project_dir
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir,
+            automatic_checkpoint_naming=True,
+            total_limit=2,  # keep only the 2 newest checkpoint_<i> dirs
+        ),
+    )
+    lr, num_epochs, seed, batch_size = (
+        config["lr"], int(config["num_epochs"]), int(config["seed"]), int(config["batch_size"])
+    )
+    set_seed(seed)
+    model_config = EncoderConfig.tiny() if (args.cpu or args.tiny) else EncoderConfig.bert_base()
+    train_dataloader, eval_dataloader = get_dataloaders(
+        accelerator, batch_size, model_config,
+        train_len=config.get("train_len", 128), eval_len=config.get("eval_len", 64),
+    )
+    model_def = EncoderClassifier(model_config, mesh=accelerator.mesh)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(seed), batch_size=batch_size, seq_len=min(model_config.max_seq_len, 128)
+    )
+    model, optimizer, train_dataloader, eval_dataloader = accelerator.prepare(
+        Model(model_def, variables), optax.adamw(lr), train_dataloader, eval_dataloader
+    )
+
+    if args.resume_from_checkpoint:
+        accelerator.print(f"Resuming from {args.resume_from_checkpoint}")
+        accelerator.load_state(args.resume_from_checkpoint)
+
+    for epoch in range(num_epochs):
+        model.train()
+        for batch in train_dataloader:
+            outputs = model(
+                batch["input_ids"], attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"], labels=batch["labels"],
+                deterministic=False,
+            )
+            accelerator.backward(outputs["loss"])
+            optimizer.step()
+            optimizer.zero_grad()
+        # automatic naming: writes <project_dir>/checkpoints/checkpoint_<i>
+        # and evicts the oldest past total_limit
+        accelerator.save_state()
+        model.eval()
+        correct = total = 0
+        for batch in eval_dataloader:
+            outputs = model(
+                batch["input_ids"], attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+            )
+            predictions = outputs["logits"].argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics((predictions, batch["labels"]))
+            correct += int((np.asarray(predictions) == np.asarray(references)).sum())
+            total += int(np.asarray(references).shape[0])
+        accelerator.print(f"epoch {epoch}: {{'accuracy': {correct / max(total, 1):.4f}}}")
+
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Checkpointing feature example.")
+    parser.add_argument("--mixed_precision", type=str, default=None, choices=["no", "fp16", "bf16"])
+    parser.add_argument("--cpu", action="store_true", help="Run the tiny config on CPU.")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model/dataset (CI).")
+    parser.add_argument("--num_epochs", type=int, default=None)
+    parser.add_argument("--project_dir", type=str, default="checkpoint_example")
+    parser.add_argument("--resume_from_checkpoint", type=str, default=None)
+    args = parser.parse_args()
+    config = {"lr": 2e-5, "num_epochs": args.num_epochs or 3, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

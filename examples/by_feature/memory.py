@@ -1,0 +1,94 @@
+"""By-feature example: OOM-adaptive batch size.
+
+Mirrors the reference feature example (/root/reference/examples/by_feature/
+memory.py): wrap the inner training function with
+`find_executable_batch_size` — on an out-of-memory failure the decorator
+halves the batch size and re-enters, so one script serves every chip size.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model, find_executable_batch_size
+from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+from accelerate_tpu.utils.random import set_seed
+
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+def training_function(config, args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    lr, num_epochs, seed = config["lr"], int(config["num_epochs"]), int(config["seed"])
+    set_seed(seed)
+    model_config = EncoderConfig.tiny() if (args.cpu or args.tiny) else EncoderConfig.bert_base()
+
+    # New for this feature: the decorated inner function receives the batch
+    # size and is retried at half size whenever it OOMs
+    @find_executable_batch_size(starting_batch_size=int(config["batch_size"]))
+    def inner_training_loop(batch_size):
+        accelerator.print(f"Trying batch_size={batch_size}")
+        accelerator.free_memory()  # drop prior attempt's engines/buffers
+        train_dataloader, eval_dataloader = get_dataloaders(
+            accelerator, batch_size, model_config,
+            train_len=config.get("train_len", 128), eval_len=config.get("eval_len", 64),
+        )
+        model_def = EncoderClassifier(model_config, mesh=accelerator.mesh)
+        variables = model_def.init_variables(
+            jax.random.PRNGKey(seed), batch_size=batch_size,
+            seq_len=min(model_config.max_seq_len, 128),
+        )
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            Model(model_def, variables), optax.adamw(lr), train_dataloader, eval_dataloader
+        )
+        for epoch in range(num_epochs):
+            model.train()
+            for batch in train_dl:
+                outputs = model(
+                    batch["input_ids"], attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"], labels=batch["labels"],
+                    deterministic=False,
+                )
+                accelerator.backward(outputs["loss"])
+                optimizer.step()
+                optimizer.zero_grad()
+            model.eval()
+            correct = total = 0
+            for batch in eval_dl:
+                outputs = model(
+                    batch["input_ids"], attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
+                )
+                predictions = outputs["logits"].argmax(axis=-1)
+                predictions, references = accelerator.gather_for_metrics(
+                    (predictions, batch["labels"])
+                )
+                correct += int((np.asarray(predictions) == np.asarray(references)).sum())
+                total += int(np.asarray(references).shape[0])
+            accelerator.print(f"epoch {epoch}: {{'accuracy': {correct / max(total, 1):.4f}}}")
+
+    inner_training_loop()
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="OOM-adaptive batch size feature example.")
+    parser.add_argument("--mixed_precision", type=str, default=None, choices=["no", "fp16", "bf16"])
+    parser.add_argument("--cpu", action="store_true", help="Run the tiny config on CPU.")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model/dataset (CI).")
+    parser.add_argument("--num_epochs", type=int, default=None)
+    args = parser.parse_args()
+    config = {"lr": 2e-5, "num_epochs": args.num_epochs or 1, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

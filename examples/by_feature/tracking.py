@@ -1,0 +1,110 @@
+"""By-feature example: experiment tracking.
+
+Mirrors the reference feature example (/root/reference/examples/by_feature/
+tracking.py): `Accelerator(log_with=...)` + `init_trackers` / `log` /
+`end_training`. The jsonl tracker used here needs no external service; swap
+`log_with="wandb"` (or tensorboard/mlflow/comet/aim/clearml/dvclive) when
+those are installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+from accelerate_tpu.utils.random import set_seed
+
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+def training_function(config, args):
+    # New for this feature: pick a tracker and a project dir
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with=args.log_with,
+        project_dir=args.project_dir,
+    )
+    accelerator.init_trackers("tracking_example", config)
+
+    lr, num_epochs, seed, batch_size = (
+        config["lr"], int(config["num_epochs"]), int(config["seed"]), int(config["batch_size"])
+    )
+    set_seed(seed)
+    model_config = EncoderConfig.tiny() if (args.cpu or args.tiny) else EncoderConfig.bert_base()
+    train_dataloader, eval_dataloader = get_dataloaders(
+        accelerator, batch_size, model_config,
+        train_len=config.get("train_len", 512), eval_len=config.get("eval_len", 128),
+    )
+    model_def = EncoderClassifier(model_config, mesh=accelerator.mesh)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(seed), batch_size=batch_size, seq_len=min(model_config.max_seq_len, 128)
+    )
+    model, optimizer, train_dataloader, eval_dataloader = accelerator.prepare(
+        Model(model_def, variables), optax.adamw(lr), train_dataloader, eval_dataloader
+    )
+
+    overall_step = 0
+    for epoch in range(num_epochs):
+        model.train()
+        total_loss = 0.0
+        for batch in train_dataloader:
+            outputs = model(
+                batch["input_ids"], attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"], labels=batch["labels"],
+                deterministic=False,
+            )
+            loss = float(jax.device_get(outputs["loss"]))
+            total_loss += loss
+            accelerator.backward(outputs["loss"])
+            optimizer.step()
+            optimizer.zero_grad()
+            overall_step += 1
+            accelerator.log({"train_loss": loss}, step=overall_step)
+
+        model.eval()
+        correct = total = 0
+        for batch in eval_dataloader:
+            outputs = model(
+                batch["input_ids"], attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+            )
+            predictions = outputs["logits"].argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics((predictions, batch["labels"]))
+            correct += int((np.asarray(predictions) == np.asarray(references)).sum())
+            total += int(np.asarray(references).shape[0])
+        accuracy = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: {{'accuracy': {accuracy:.4f}}}")
+        accelerator.log(
+            {"accuracy": accuracy, "epoch_loss": total_loss / max(len(train_dataloader), 1)},
+            step=overall_step,
+        )
+
+    accelerator.end_training()  # flushes/closes every tracker
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Tracking feature example.")
+    parser.add_argument("--mixed_precision", type=str, default=None, choices=["no", "fp16", "bf16"])
+    parser.add_argument("--cpu", action="store_true", help="Run the tiny config on CPU.")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model/dataset (CI).")
+    parser.add_argument("--num_epochs", type=int, default=None)
+    parser.add_argument("--log_with", type=str, default="jsonl")
+    parser.add_argument("--project_dir", type=str, default="tracking_logs")
+    args = parser.parse_args()
+    config = {"lr": 2e-5, "num_epochs": args.num_epochs or 3, "seed": 42, "batch_size": 16}
+    if args.tiny or args.cpu:
+        config.update({"train_len": 128, "eval_len": 64})
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,36 @@ class TestExamplesRun:
         assert r.returncode == 0, r.stderr
         assert "accuracy" in r.stdout
 
+    def test_tracking_example(self, tmp_path):
+        r = _run_example(os.path.join("by_feature", "tracking.py"),
+                         "--project_dir", str(tmp_path))
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout
+
+    def test_checkpointing_example_rotates(self, tmp_path):
+        r = _run_example(os.path.join("by_feature", "checkpointing.py"),
+                         "--num_epochs", "3", "--project_dir", str(tmp_path))
+        assert r.returncode == 0, r.stderr
+        ckpts = sorted(os.listdir(tmp_path / "checkpoints"))
+        assert len(ckpts) == 2, ckpts  # total_limit=2 evicted the oldest
+        r2 = _run_example(
+            os.path.join("by_feature", "checkpointing.py"),
+            "--project_dir", str(tmp_path / "resume_run"),
+            "--resume_from_checkpoint", str(tmp_path / "checkpoints" / ckpts[-1]),
+        )
+        assert r2.returncode == 0, r2.stderr
+
+    def test_local_sgd_example(self):
+        r = _run_example(os.path.join("by_feature", "local_sgd.py"),
+                         "--local_sgd_steps", "2")
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout
+
+    def test_memory_example(self):
+        r = _run_example(os.path.join("by_feature", "memory.py"))
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout
+
     def test_complete_example_checkpoints_and_resumes(self, tmp_path):
         r = _run_example(
             "complete_nlp_example.py",
@@ -78,7 +108,14 @@ class TestExamplesDiff:
             return f.read()
 
     def test_feature_scripts_reuse_base_data_pipeline(self):
-        for rel in ("by_feature/gradient_accumulation.py", "complete_nlp_example.py"):
+        for rel in (
+            "by_feature/gradient_accumulation.py",
+            "by_feature/tracking.py",
+            "by_feature/checkpointing.py",
+            "by_feature/local_sgd.py",
+            "by_feature/memory.py",
+            "complete_nlp_example.py",
+        ):
             src = self._src(rel)
             assert "from nlp_example import" in src, f"{rel} copies instead of importing"
             assert "class ParaphraseDataset" not in src, f"{rel} duplicates the dataset"
