@@ -85,7 +85,15 @@ class DispatchedModel:
         self.params = params
         self.mesh = mesh
         self.device_map = dict(device_map or {})
-        self._jit = None
+        # compiled programs and placement transforms keyed by placement
+        # state, so materialize()/offload() ping-pong (CpuOffloadHook
+        # pipelines) reuses the compile for each tier layout instead of
+        # retracing every promote/demote
+        self._jits: dict = {}
+        self._placers: dict = {}
+
+    def _placement_key(self):
+        return tuple(sorted(self.device_map.items()))
 
     # sentinel "shardings" for host-tier params:
     _STREAM = "host_stream"      # model streams this subtree itself (per-layer)
@@ -156,7 +164,8 @@ class DispatchedModel:
 
         params = self._concrete(self.params)
         traced_args, static_args, traced_kw, static_kw = _split_static_call(args, kwargs)
-        if self._jit is None:
+        key = self._placement_key()
+        if key not in self._jits:
             from .accelerator import _merge_static_call
 
             placer = self.param_placer()
@@ -165,21 +174,30 @@ class DispatchedModel:
                 a, kw = _merge_static_call(a, kw, s_args, s_kw)
                 return self.definition.apply({"params": placer(p)}, *a, **kw)
 
-            self._apply = apply
-            self._jit = jax.jit(apply, static_argnums=(3, 4))
+            self._jits[key] = (apply, jax.jit(apply, static_argnums=(3, 4)))
+        apply, jitted = self._jits[key]
         try:
             hash((static_args, static_kw))
         except TypeError:
-            return self._apply(params, traced_args, traced_kw, static_args, static_kw)
-        return self._jit(params, traced_args, traced_kw, static_args, static_kw)
+            return apply(params, traced_args, traced_kw, static_args, static_kw)
+        return jitted(params, traced_args, traced_kw, static_args, static_kw)
 
     def param_placer(self):
         """In-graph placement transform used by this model's jit (and by
         generation): device-tier leaves pin to their sharding, non-streamable
         host leaves transfer at the jit boundary, streamable subtrees stay in
         pinned host for the model's per-layer streaming, and quantized
-        weights dequantize in-graph (fused into consumers)."""
+        weights dequantize in-graph (fused into consumers).
+
+        Cached per placement state so repeat calls (and generation's jitted
+        loops, which key on placer identity) reuse compiled programs until
+        the device_map actually changes."""
         from .utils.quantization import dequantize_params
+
+        key = self._placement_key()
+        cached = self._placers.get(key)
+        if cached is not None:
+            return cached
 
         shardings = self._target_shardings()
         stream = self._STREAM
@@ -195,12 +213,15 @@ class DispatchedModel:
             p = jax.tree_util.tree_map(_place, p, shardings)
             return dequantize_params(p)
 
+        self._placers[key] = placer
         return placer
 
     def materialize(self):
         """Force all params into device memory (drops offload tiers).
         No-op when already fully on device — a hooked pipeline calls this
-        every forward and must not retrace each time."""
+        every forward; the compiled program for each placement state is
+        cached (``_jits``/``_placers``), so ping-ponging between tiers does
+        not retrace."""
         if self.device_map == {"": "device"}:
             return self
         params = self._concrete(self.params)
@@ -208,7 +229,6 @@ class DispatchedModel:
         params = jax.tree_util.tree_map(jax.device_put, params, shardings)
         self.params = params
         self.device_map = {"": "device"}
-        self._jit = None  # placements changed; retrace
         return self
 
     def offload(self):
@@ -221,7 +241,6 @@ class DispatchedModel:
             lambda p: _to_pinned_host(np.asarray(jax.device_get(p))), params
         )
         self.device_map = {"": "cpu"}
-        self._jit = None
         return self
 
 

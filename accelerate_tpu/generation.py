@@ -116,14 +116,16 @@ def generate(
     if param_placer is None:
         from .utils.quantization import dequantize_params as param_placer  # noqa: F811
 
+    prefill_rng, decode_rng = jax.random.split(rng)
+
     prefill = _prefill_for(definition, temperature, top_k, param_placer)
     t0 = time.perf_counter()
-    last, cache = prefill(params, input_ids, rng)
+    last, cache = prefill(params, input_ids, prefill_rng)
     jax.block_until_ready(last)
     prefill_seconds = time.perf_counter() - t0
 
     loop = _decode_loop_for(definition, max_new_tokens - 1, temperature, top_k, param_placer)
-    tokens = loop(params, cache, last, jnp.asarray(s, jnp.int32), rng)
+    tokens = loop(params, cache, last, jnp.asarray(s, jnp.int32), decode_rng)
     result = jnp.concatenate([input_ids, last[:, None], tokens], axis=1)
     if return_prefill_seconds:
         return result, prefill_seconds
@@ -156,10 +158,10 @@ def generate_dispatched(dispatched, input_ids, **kwargs):
     offloaded / quantized) params, its streaming-enabled definition, and its
     in-graph placement transform."""
     params = dispatched._concrete(dispatched.params)
-    # cache the placer on the model so repeat calls hit the jitted loops
-    if not hasattr(dispatched, "_gen_placer"):
-        dispatched._gen_placer = dispatched.param_placer()
+    # param_placer() is cached per placement state on the model, so repeat
+    # calls hit the jitted loops while materialize()/offload() (which change
+    # the device_map) naturally key a fresh placer + compile
     return generate(
         dispatched.definition, params, input_ids,
-        param_placer=dispatched._gen_placer, **kwargs
+        param_placer=dispatched.param_placer(), **kwargs
     )

@@ -67,23 +67,52 @@ class LocalSGD:
         self.step_qty = 0
         if self.enabled:
             self._stack_state()
+            engine = self._engine
+            self._enter_step_count = engine.step_count if engine is not None else 0
             self._active = True
         return self
 
     def __exit__(self, *exc):
-        if self._active:
-            self._sync_and_avg_model_params()
-            self._collapse_state()
+        if not self._active:
+            return False
+        if exc and exc[0] is not None:
+            # an exception is already unwinding: don't collapse the snapshot
+            # over the engine (and don't raise the misuse guard over it) —
+            # drop the per-replica copies and leave engine state untouched
             self._active = False
+            self._stacked = None
+            return False
+        self._check_engine_untouched()
+        self._sync_and_avg_model_params()
+        self._collapse_state()
+        self._active = False
         return False
 
     def step(self):
-        """Call once per local optimizer step (reference local_sgd.py:78)."""
+        """Advance the LocalSGD step counter and sync every ``local_sgd_steps``.
+
+        Must be paired with the step function returned by
+        :meth:`build_local_step` — while the context is active the engine's
+        own train step must NOT run (its updates would be overwritten by the
+        stacked per-replica copies on exit; this raises if it did).
+        """
         self.step_qty += 1
         if not self._active:
             return
+        self._check_engine_untouched()
         if self.step_qty % self.num_steps == 0:
             self._sync_and_avg_model_params()
+
+    def _check_engine_untouched(self):
+        engine = self._engine
+        if engine is not None and engine.step_count != self._enter_step_count:
+            raise RuntimeError(
+                "LocalSGD: the prepared engine advanced "
+                f"{engine.step_count - self._enter_step_count} step(s) while the "
+                "per-replica snapshot was active; those updates would be lost on "
+                "exit. Inside the LocalSGD context, drive training with the step "
+                "returned by build_local_step(), not the engine's train step."
+            )
 
     # ------------------------------------------------------------------
     def _spec(self):

@@ -256,6 +256,12 @@ def infer_auto_device_map(
                 placed = True
                 break
             children = _child_groups(all_paths, group)
+            # descend through single-child wrapper chains: the lone child is
+            # the same bytes as its parent, so the split point that matters
+            # is the first level with real fan-out (grandchildren may fit
+            # where the wrapper as a whole does not)
+            while len(children) == 1:
+                children = _child_groups(all_paths, children[0])
             if len(children) > 1 and remaining[tier] > 0:
                 # split on overflow: the front children may still fit here
                 worklist.extendleft(reversed(children))
