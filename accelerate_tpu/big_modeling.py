@@ -91,6 +91,10 @@ class DispatchedModel:
         # retracing every promote/demote
         self._jits: dict = {}
         self._placers: dict = {}
+        # AOT executables from aot_compile(), keyed by (placement, avals):
+        # __call__ uses one directly when the call signature matches
+        self._aot: dict = {}
+        self._aot_hits = 0
 
     def _placement_key(self):
         return tuple(sorted(self.device_map.items()))
@@ -157,14 +161,8 @@ class DispatchedModel:
             _mat, params, is_leaf=lambda l: isinstance(l, _DiskWeight)
         )
 
-    def __call__(self, *args, **kwargs):
-        # bool/str/None inputs go in as jit statics (Python control flow in
-        # flax modules); same partition the TrainEngine uses.
-        from .accelerator import _split_static_call
-
-        params = self._concrete(self.params)
-        traced_args, static_args, traced_kw, static_kw = _split_static_call(args, kwargs)
-        key = self._placement_key()
+    def _apply_for(self, key):
+        """(apply, jitted) for the current placement key, built once."""
         if key not in self._jits:
             from .accelerator import _merge_static_call
 
@@ -175,11 +173,104 @@ class DispatchedModel:
                 return self.definition.apply({"params": placer(p)}, *a, **kw)
 
             self._jits[key] = (apply, jax.jit(apply, static_argnums=(3, 4)))
-        apply, jitted = self._jits[key]
+        return self._jits[key]
+
+    @staticmethod
+    def _aval_key(tree):
+        # jnp.shape/result_type, not .shape/.dtype: traced leaves may be
+        # Python scalars (ints/floats pass _split_static_call as traced)
+        return tuple(
+            (jnp.shape(l), str(jnp.result_type(l)))
+            for l in jax.tree_util.tree_leaves(tree)
+        )
+
+    def _abstract_params(self):
+        """ShapeDtypeStructs mirroring what ``_concrete(self.params)`` will
+        be at call time: device-tier leaves carry the loader's mesh sharding
+        (or stay uncommitted = default device single-chip), host/disk-tier
+        leaves are committed to pinned host. Matching the real placements is
+        what lets __call__ use the AOT executable instead of retracing."""
+        from jax.sharding import SingleDeviceSharding
+
+        flat = flatten_pytree(self.params)
+        pinned = None
+        dev = jax.local_devices()[0]
+        try:
+            if any(m.kind == "pinned_host" for m in dev.addressable_memories()):
+                pinned = SingleDeviceSharding(dev, memory_kind="pinned_host")
+        except Exception:  # pragma: no cover
+            pinned = None
+        mesh_shardings = None
+        if self.mesh is not None:
+            from .parallel.sharding import infer_param_sharding
+            from .utils.dataclasses import ShardingConfig
+
+            abstract = {
+                p: jax.ShapeDtypeStruct(tuple(l.shape), l.dtype) for p, l in flat.items()
+            }
+            mesh_shardings = flatten_pytree(
+                infer_param_sharding(
+                    unflatten_to_like(abstract, self.params), self.mesh, ShardingConfig()
+                )
+            )
+        out = {}
+        for path, leaf in flat.items():
+            tier = placement_of(path, self.device_map) if self.device_map else "device"
+            shape, dtype = tuple(leaf.shape), leaf.dtype
+            if tier == "device" and mesh_shardings is not None:
+                out[path] = jax.ShapeDtypeStruct(shape, dtype, sharding=mesh_shardings[path])
+            elif tier == "device" or pinned is None:
+                out[path] = jax.ShapeDtypeStruct(shape, dtype)
+            else:
+                out[path] = jax.ShapeDtypeStruct(shape, dtype, sharding=pinned)
+        return unflatten_to_like(out, self.params)
+
+    def aot_compile(self, *args, **kwargs):
+        """Ahead-of-time compile the placed apply for these example args
+        (shapes/dtypes only — values ignored). Runs in the calling thread, so
+        ``load_checkpoint_and_dispatch`` overlaps it with checkpoint
+        streaming; with the persistent compile cache on, the executable also
+        serves every later process. Returns self."""
+        from .accelerator import _split_static_call
+        from .utils.compile_cache import ensure_persistent_compile_cache
+
+        ensure_persistent_compile_cache()
+        traced_args, static_args, traced_kw, static_kw = _split_static_call(args, kwargs)
+        key = self._placement_key()
+        _, jitted = self._apply_for(key)
+        abstract = self._abstract_params()
+        to_aval = lambda t: jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l)), t
+        )
+        a_args, a_kw = to_aval(traced_args), to_aval(traced_kw)
+        compiled = jitted.lower(abstract, a_args, a_kw, static_args, static_kw).compile()
+        self._aot[(key, self._aval_key((abstract, a_args, a_kw)), static_args, static_kw)] = compiled
+        return self
+
+    def __call__(self, *args, **kwargs):
+        # bool/str/None inputs go in as jit statics (Python control flow in
+        # flax modules); same partition the TrainEngine uses.
+        from .accelerator import _split_static_call
+
+        params = self._concrete(self.params)
+        traced_args, static_args, traced_kw, static_kw = _split_static_call(args, kwargs)
+        key = self._placement_key()
+        apply, jitted = self._apply_for(key)
         try:
             hash((static_args, static_kw))
         except TypeError:
             return apply(params, traced_args, traced_kw, static_args, static_kw)
+        aot = None
+        if self._aot:  # skip the per-leaf key build entirely for non-AOT users
+            aot = self._aot.get((key, self._aval_key((params, traced_args, traced_kw)),
+                                 static_args, static_kw))
+        if aot is not None:
+            try:
+                out = aot(params, traced_args, traced_kw)
+                self._aot_hits += 1
+                return out
+            except (TypeError, ValueError):  # placement drifted from the AOT avals
+                pass
         return jitted(params, traced_args, traced_kw, static_args, static_kw)
 
     def param_placer(self):
@@ -381,11 +472,21 @@ def load_checkpoint_and_dispatch(
     dtype=None,
     mesh=None,
     rng=None,
+    precompile: bool = True,
     **sample_kwargs,
 ) -> DispatchedModel:
     """Abstract-init -> auto device map -> stream checkpoint weights straight
     to their tier (reference load_checkpoint_and_dispatch:504; device-bound
-    weights never make a full-model host copy)."""
+    weights never make a full-model host copy).
+
+    With ``precompile`` (default), the forward program for ``sample_args`` is
+    XLA-compiled on a background thread *while* the checkpoint streams from
+    disk to its tiers — compile time hides under I/O instead of adding to
+    time-to-first-token, and the persistent compile cache makes it a one-time
+    cost across processes."""
+    from .utils.compile_cache import ensure_persistent_compile_cache
+
+    ensure_persistent_compile_cache()
     abstract = init_empty_weights(definition, *sample_args, rng=rng, **sample_kwargs)
     abstract_params = abstract["params"] if isinstance(abstract, dict) and "params" in abstract else abstract
     if isinstance(device_map, str):
@@ -395,6 +496,43 @@ def load_checkpoint_and_dispatch(
             )
         else:
             device_map = {"": device_map}
+
+    model = None
+    compile_thread = None
+    compile_err: list = []
+    if precompile and sample_args:
+        # the dispatched apply's input avals depend only on shapes/placements,
+        # both known before any weight bytes move — compile concurrently.
+        # Dtypes come from the checkpoint HEADER (a bf16 checkpoint loads as
+        # bf16 regardless of the model's init dtype), with the explicit
+        # ``dtype`` override applied the same way the loader applies it.
+        from .utils.serialization import peek_flat_structs
+
+        peeked = peek_flat_structs(checkpoint) or {}
+
+        def _cast(path, leaf):
+            src = peeked.get(path, leaf)
+            out_dtype = src.dtype
+            if dtype is not None and jnp.issubdtype(out_dtype, jnp.floating):
+                out_dtype = dtype
+            return jax.ShapeDtypeStruct(leaf.shape, out_dtype)
+
+        flat_abs = flatten_pytree(abstract_params)
+        cast_abstract = unflatten_to_like(
+            {p: _cast(p, l) for p, l in flat_abs.items()}, abstract_params
+        )
+        model = DispatchedModel(definition, cast_abstract, mesh=mesh, device_map=device_map)
+        import threading
+
+        def _compile():
+            try:
+                model.aot_compile(*sample_args, **sample_kwargs)
+            except Exception as e:  # pragma: no cover - AOT is best-effort
+                compile_err.append(e)
+
+        compile_thread = threading.Thread(target=_compile, daemon=True)
+        compile_thread.start()
+
     params = load_checkpoint_in_model(
         abstract_params,
         checkpoint,
@@ -403,4 +541,9 @@ def load_checkpoint_and_dispatch(
         dtype=dtype,
         mesh=mesh,
     )
+    if compile_thread is not None:
+        compile_thread.join()
+    if model is not None and not compile_err:
+        model.params = params
+        return model
     return DispatchedModel(definition, params, mesh=mesh, device_map=device_map)

@@ -44,6 +44,46 @@ def _sample(logits, key, temperature: float, top_k: Optional[int]):
 _LOOP_CACHE: dict = {}
 _LOOP_CACHE_LIMIT = 32
 
+# right-sized definition clones keyed by (id(original), cache_len): reusing
+# the same clone keeps id(definition) stable so the jitted loops re-hit
+_SIZED_DEF_CACHE: dict = {}
+
+_CACHE_BUCKET = 256
+
+
+def _right_size_cache(definition, prompt_len: int, max_new_tokens: int):
+    """Clone the definition with max_cache_len = prompt+budget rounded up to
+    a 256 bucket. Decode attention cost scales with the cache length, so a
+    128-token prompt generating 64 tokens should not pay for a
+    max_seq_len=2048 cache (~1 ms/token extra on a 0.39B model). Bucketing
+    bounds recompiles; an explicit config.max_cache_len is respected."""
+    cfg = getattr(definition, "config", None)
+    if cfg is None or not hasattr(cfg, "max_cache_len") or cfg.max_cache_len is not None:
+        return definition
+    import dataclasses as _dc
+
+    need = prompt_len + max_new_tokens
+    sized = -(-need // _CACHE_BUCKET) * _CACHE_BUCKET
+    limit = getattr(cfg, "max_seq_len", None)
+    if limit is not None:
+        sized = min(sized, limit)
+    if sized < need:
+        return definition  # over max_seq_len; let the capacity check raise
+    key = (id(definition), sized)
+    hit = _SIZED_DEF_CACHE.get(key)
+    # the stored original pins it alive AND guards against id() reuse after
+    # an unrelated definition lands at the same address
+    if hit is not None and hit[0] is definition:
+        return hit[1]
+    try:
+        clone = definition.clone(config=_dc.replace(cfg, max_cache_len=sized))
+    except Exception:
+        return definition
+    if len(_SIZED_DEF_CACHE) >= _LOOP_CACHE_LIMIT:
+        _SIZED_DEF_CACHE.pop(next(iter(_SIZED_DEF_CACHE)))
+    _SIZED_DEF_CACHE[key] = (definition, clone)
+    return clone
+
 
 def _cache_put(key, value):
     if len(_LOOP_CACHE) >= _LOOP_CACHE_LIMIT:
@@ -102,8 +142,12 @@ def generate(
     placement / dequantization); defaults to dequantize-only."""
     import time
 
+    from .utils.compile_cache import ensure_persistent_compile_cache
+
+    ensure_persistent_compile_cache()
     input_ids = jnp.asarray(input_ids)
     b, s = input_ids.shape
+    definition = _right_size_cache(definition, s, max_new_tokens)
     cfg = getattr(definition, "config", None)
     if cfg is not None:
         cap = getattr(cfg, "max_cache_len", None) or getattr(cfg, "max_seq_len", None)
@@ -121,7 +165,11 @@ def generate(
     prefill = _prefill_for(definition, temperature, top_k, param_placer)
     t0 = time.perf_counter()
     last, cache = prefill(params, input_ids, prefill_rng)
-    jax.block_until_ready(last)
+    if return_prefill_seconds:
+        # device_get, not block_until_ready: the latter does not actually
+        # block through remote-attached runtimes, and `last` transitively
+        # depends on the whole prefill. Only the timed path pays the sync.
+        last = jnp.asarray(jax.device_get(last))
     prefill_seconds = time.perf_counter() - t0
 
     loop = _decode_loop_for(definition, max_new_tokens - 1, temperature, top_k, param_placer)

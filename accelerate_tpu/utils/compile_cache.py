@@ -1,0 +1,48 @@
+"""Persistent XLA compilation cache management.
+
+The reference pays no compilation cost (torch eager): its 8.7 s GPT-J "load
+time" (reference benchmarks/big_model_inference/README.md:31) is pure I/O.
+Under XLA the first trace of a dispatched model costs tens of seconds, which
+would dominate time-to-first-token. The persistent compilation cache makes
+that a one-time cost per (program, topology): every later process — including
+restarts after preemption (SURVEY §5 failure recovery) — deserializes the
+executable instead of recompiling.
+
+``ensure_persistent_compile_cache()`` is called by the dispatch path
+(big_modeling), generation, and the Accelerator when a CompilePlugin enables
+it; set ``ATT_COMPILE_CACHE=0`` to disable or to a path to relocate.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "accelerate_tpu", "xla_cache"
+)
+_enabled_dir: str | None = None
+
+
+def ensure_persistent_compile_cache(cache_dir: str | None = None) -> str | None:
+    """Idempotently enable the JAX persistent compilation cache.
+
+    Resolution order: explicit ``cache_dir`` arg > ``ATT_COMPILE_CACHE`` env
+    ("0"/"false"/"" disables) > ``~/.cache/accelerate_tpu/xla_cache``.
+    Returns the active cache dir (None when disabled)."""
+    global _enabled_dir
+    env = os.environ.get("ATT_COMPILE_CACHE")
+    if cache_dir is None:
+        if env is not None and env.lower() in ("0", "false", ""):
+            return None
+        cache_dir = env or _DEFAULT_DIR
+    if _enabled_dir == cache_dir:
+        return _enabled_dir
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache everything that takes noticeable time; entries are content-hashed
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _enabled_dir = cache_dir
+    return _enabled_dir

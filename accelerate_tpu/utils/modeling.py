@@ -349,6 +349,14 @@ def load_checkpoint_in_model(
             value = value.astype(dtype)
         tier = placement_of(path, device_map)
         if tier == "device":
+            if value.base is not None and isinstance(value.base, np.memmap):
+                # lift mmap-backed views into RAM before the transfer: the
+                # runtime's h2d path can fall off its fast path on
+                # mmap-backed/unaligned sources, and the copy (~GB/s) is
+                # cheap insurance. Reads stay lazy until exactly here, so
+                # disk I/O still overlaps the previous tensor's transfer
+                # (device_put is async).
+                value = np.array(value, copy=True)
             if shardings is not None:
                 out[path] = jax.device_put(jnp.asarray(value), shardings[path])
             else:

@@ -296,10 +296,62 @@ def _load_dist(base: str) -> dict[str, np.ndarray]:
             f"tile the global shape for {list(bad)[:5]} (have/need = {list(bad.values())[:5]})"
         )
     for fpath, refs in per_file.items():
-        data = _load_safetensors(fpath)
+        # eager path: assembly copies every chunk anyway, and the native
+        # parallel pread (csrc/att_runtime) beats page-in-then-copy
+        data = _load_safetensors(fpath, zero_copy=False)
         for key, ck in refs:
             sl = tuple(slice(s, s + n) for s, n in zip(ck["start"], ck["shape"]))
             out[key][sl] = data[ck["key"]]
+    return out
+
+
+def peek_flat_structs(path: str | os.PathLike) -> dict[str, Any] | None:
+    """Read shapes/dtypes from safetensors header(s) WITHOUT touching tensor
+    bytes — {path: jax.ShapeDtypeStruct}. Returns None for formats without a
+    cheap header (pickle). The dispatch path uses this to AOT-compile for
+    the checkpoint's real dtypes while the data still streams."""
+    import ml_dtypes
+
+    path = str(path)
+    if _find_dist_manifests(path):
+        out = {}
+        code_to_np = dict(_SAFETENSORS_DTYPES)
+        code_to_np["BF16"] = ml_dtypes.bfloat16
+        for mpath in _find_dist_manifests(path):
+            with open(mpath) as f:
+                man = json.load(f)
+            for key, info in man["tensors"].items():
+                out[key] = jax.ShapeDtypeStruct(tuple(info["shape"]), code_to_np[info["dtype"]])
+        return out
+    if path.endswith(".index.json") or (not os.path.exists(path) and os.path.exists(path + ".index.json")):
+        index_path = path if path.endswith(".index.json") else path + ".index.json"
+        with open(index_path) as f:
+            index = json.load(f)
+        folder = os.path.dirname(index_path)
+        out = {}
+        for fname in sorted(set(index["weight_map"].values())):
+            part = peek_flat_structs(os.path.join(folder, fname))
+            if part is None:
+                return None
+            out.update(part)
+        return out
+    if not (path.endswith(".safetensors") or _is_safetensors(path)):
+        return None
+    with open(path, "rb") as f:
+        header_len = int.from_bytes(f.read(8), "little")
+        header = json.loads(f.read(header_len))
+    out = {}
+    for name, info in header.items():
+        if name == "__metadata__":
+            continue
+        dt = info["dtype"]
+        if dt == "BF16":
+            np_dtype = ml_dtypes.bfloat16
+        elif dt in _SAFETENSORS_DTYPES:
+            np_dtype = _SAFETENSORS_DTYPES[dt]
+        else:
+            return None
+        out[name] = jax.ShapeDtypeStruct(tuple(info["shape"]), np_dtype)
     return out
 
 
@@ -334,30 +386,30 @@ _SAFETENSORS_DTYPES = {
 }
 
 
-def _load_safetensors(path: str) -> dict[str, np.ndarray]:
-    """Safetensors load via the native parallel reader (csrc/att_runtime):
-    the header is parsed in Python, then every tensor's byte segment is
-    pread on C++ threads straight into its destination array — checkpoint
-    load time is a headline metric (reference big_model_inference loads run
-    8.7-112s on the published table). Falls back to safetensors.numpy."""
-    from ..runtime.native import native_available, parallel_read_segments
+def _load_safetensors(path: str, zero_copy: bool | None = None) -> dict[str, np.ndarray]:
+    """Safetensors load. Two paths:
 
-    try:
-        available = native_available()
-    except Exception:
-        available = False
-    if not available:
-        from safetensors.numpy import load_file
+    - ``zero_copy`` (default): tensors are read-only views into one
+      ``np.memmap`` of the file — no bytes are copied until a consumer (e.g.
+      ``jax.device_put``) touches them, so disk page-in overlaps with the
+      host->device transfer. Checkpoint load time is a headline metric
+      (reference big_model_inference loads run 8.7-112 s on the published
+      table) and the copy was the single biggest term in it.
+    - eager (``zero_copy=False`` or ``ATT_EAGER_READ=1``): every tensor's
+      byte segment is pread on C++ threads (csrc/att_runtime) into fresh
+      writable arrays; used by the distributed-checkpoint assembler.
 
-        return load_file(path)
+    Falls back to safetensors.numpy on unknown dtype codes."""
+    if zero_copy is None:
+        zero_copy = os.environ.get("ATT_EAGER_READ", "0").lower() in ("0", "false", "")
     file_size = os.path.getsize(path)
     with open(path, "rb") as f:
         header_len = int.from_bytes(f.read(8), "little")
         header = json.loads(f.read(header_len))
     data_start = 8 + header_len
-    names, offsets, dests = [], [], []
     import ml_dtypes
 
+    parsed = []
     for name, info in header.items():
         if name == "__metadata__":
             continue
@@ -372,23 +424,47 @@ def _load_safetensors(path: str) -> dict[str, np.ndarray]:
             from safetensors.numpy import load_file
 
             return load_file(path)
-        arr = np.empty(tuple(info["shape"]), dtype=np_dtype)
+        shape = tuple(info["shape"])
         begin, end = info["data_offsets"]
-        if end - begin != arr.nbytes:
+        nbytes = int(np.prod(shape)) * np.dtype(np_dtype).itemsize if shape else np.dtype(np_dtype).itemsize
+        if end - begin != nbytes:
             raise ValueError(
                 f"corrupt safetensors header in {path}: tensor {name!r} spans "
-                f"{end - begin} bytes but dtype/shape imply {arr.nbytes}"
+                f"{end - begin} bytes but dtype/shape imply {nbytes}"
             )
         if begin < 0 or data_start + end > file_size:
             raise ValueError(
                 f"corrupt safetensors header in {path}: tensor {name!r} offsets "
                 f"[{begin}, {end}) fall outside the file ({file_size} bytes)"
             )
+        parsed.append((name, shape, np_dtype, begin, end))
+
+    if zero_copy:
+        mm = np.memmap(path, np.uint8, mode="r")
+        return {
+            name: mm[data_start + begin : data_start + end].view(np_dtype).reshape(shape)
+            for name, shape, np_dtype, begin, end in parsed
+        }
+
+    from ..runtime.native import native_available, parallel_read_segments
+
+    try:
+        available = native_available()
+    except Exception:
+        available = False
+    names, offsets, dests = [], [], []
+    for name, shape, np_dtype, begin, end in parsed:
         names.append(name)
         offsets.append(data_start + begin)
-        dests.append(arr)
-    if dests:
-        parallel_read_segments(path, offsets, dests)
+        dests.append(np.empty(shape, dtype=np_dtype))
+    if available:
+        if dests:
+            parallel_read_segments(path, offsets, dests)
+    else:
+        with open(path, "rb") as f:
+            for off, arr in zip(offsets, dests):
+                f.seek(off)
+                f.readinto(memoryview(arr).cast("B"))
     return dict(zip(names, dests))
 
 

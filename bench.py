@@ -11,6 +11,14 @@ big-model-inference analog).
 On a real TPU chip this trains a ~390M-param LLaMA-style model in bf16
 (pallas flash attention, fused-CE loss, remat+scan); on CPU everything falls
 back to tiny configs so the harness always produces a number.
+
+Measurement notes (the TPU here is tunnel-attached):
+- ``jax.block_until_ready`` does NOT block through remote-attached runtimes;
+  every timed quantity is forced with a ``device_get`` of a value that
+  transitively depends on the full computation.
+- The host<->device link is bursty (observed 10 MB/s .. 1.6 GB/s), so the
+  TTFT metric is a p50 over fresh-process attempts and decode latency is
+  measured differentially (two loop lengths) to cancel link round trips.
 """
 
 from __future__ import annotations
@@ -42,6 +50,21 @@ def _peak_flops(device) -> float:
         if name.lower() in kind:
             return flops
     return 200e12  # conservative default for unknown TPU; CPU runs report vs this
+
+
+def _named_configs(on_tpu: bool):
+    """TTFT worker configs addressable by name across processes."""
+    from accelerate_tpu.models import DecoderConfig
+
+    if on_tpu:
+        return {
+            "ttft_390m": DecoderConfig(
+                vocab_size=32_000, num_layers=12, embed_dim=1536, num_heads=12,
+                num_kv_heads=12, mlp_dim=4096, max_seq_len=2048,
+                dtype=jnp.bfloat16, remat=False, scan_layers=True,
+            ),
+        }
+    return {"ttft_tiny": DecoderConfig.tiny()}
 
 
 def _train_bench(cfg, batch_size, seq_len, steps, mixed_precision):
@@ -87,57 +110,118 @@ def _train_bench(cfg, batch_size, seq_len, steps, mixed_precision):
     return tokens_per_sec, mfu, final_loss, dt / steps
 
 
-def _ttft_bench(cfg, prompt_len, tmpdir):
-    """Dispatch-to-first-token: checkpoint on disk -> auto device map ->
-    logits for the last prompt position (BASELINE big_model_inference rows:
-    load time + first generation step)."""
-    from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
-    from accelerate_tpu.models import DecoderLM
-    from accelerate_tpu.utils.serialization import save_pytree
-
+def _write_host_checkpoint(cfg, prompt_len, tmpdir):
+    """Build a random checkpoint entirely host-side (shapes via eval_shape,
+    numpy fill — no device traffic) and save it in the serving dtype. The
+    BASELINE table's fp16 rows load half-precision checkpoints; bf16 is the
+    TPU-native analog."""
     import os
 
+    import ml_dtypes
+
+    from accelerate_tpu.big_modeling import init_empty_weights
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.utils.serialization import (
+        flatten_pytree,
+        save_pytree,
+        unflatten_to_like,
+    )
+
     model_def = DecoderLM(cfg)
-    variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len)
-    from accelerate_tpu.parallel.sharding import unbox_params
-
-    params, _ = unbox_params(variables["params"])
+    abstract = init_empty_weights(model_def, jnp.zeros((1, prompt_len), jnp.int32))
+    abstract = abstract["params"] if "params" in abstract else abstract
+    rng = np.random.RandomState(0)
+    dt = np.dtype(ml_dtypes.bfloat16)
+    flat = {
+        k: (rng.standard_normal(v.shape) * 0.02).astype(dt)
+        for k, v in flatten_pytree(abstract).items()
+    }
     ckpt = os.path.join(tmpdir, "model.safetensors")
-    save_pytree(params, ckpt, max_shard_size=1 << 30)
-    del params, variables
+    save_pytree(unflatten_to_like(flat, abstract), ckpt, max_shard_size=1 << 30)
+    return ckpt
 
+
+def _ttft_once(cfg, ckpt, prompt_len):
+    """One dispatch-to-first-token attempt in THIS process: checkpoint on
+    disk -> auto device map (AOT compile overlapped with the weight stream)
+    -> last-position logits on host (BASELINE big_model_inference rows: load
+    time + first step). Only the [1, vocab] slice crosses device->host —
+    fetching full [1, S, vocab] logits would time the tunnel, not the
+    model."""
+    from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+    from accelerate_tpu.models import DecoderLM
+
+    model_def = DecoderLM(cfg)
     ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (1, prompt_len))
     t0 = time.perf_counter()
     dispatched = load_checkpoint_and_dispatch(
         model_def, ckpt, jnp.zeros((1, prompt_len), jnp.int32), device_map="auto"
     )
     out = dispatched(jnp.asarray(ids))
-    first_logits = np.asarray(jax.device_get(out["logits"]))[:, -1]
+    first_logits = np.asarray(jax.device_get(out["logits"][:, -1]))
     ttft = time.perf_counter() - t0
     assert np.all(np.isfinite(first_logits))
     return ttft
 
 
-def _decode_bench(cfg, prompt_len, new_tokens):
-    """Greedy generation s/token on device-resident weights (the BASELINE
-    big-model-inference table's generation metric)."""
+def _ttft_bench(cfg_name, prompt_len, tmpdir, attempts=3):
+    """p50 TTFT over fresh-process attempts (BASELINE's metric is p50 TTFT).
+    Each attempt re-imports jax, re-reads the checkpoint, re-places, and
+    re-compiles; the persistent XLA cache makes compile a one-time cost, so
+    attempt 1 bounds the cold number and the median is the steady serving
+    number. Returns (p50, cold)."""
+    import subprocess
+
+    times = []
+    for _ in range(attempts):
+        out = subprocess.run(
+            [sys.executable, __file__, "--_ttft_worker", cfg_name,
+             str(prompt_len), tmpdir],
+            capture_output=True, text=True, timeout=900,
+        )
+        lines = [l for l in out.stdout.splitlines() if l.startswith("TTFT ")]
+        assert lines, f"ttft worker failed: {out.stderr[-2000:]}"
+        times.append(float(lines[-1].split()[1]))
+    return float(np.median(times)), times
+
+
+def _decode_bench(cfg, prompt_len, base_tokens=16, extra_tokens=256):
+    """Greedy generation s/token on device-resident bf16 weights (the
+    BASELINE big_model_inference generation metric). Differential timing —
+    (t[base+extra] - t[base]) / extra — cancels prefill, dispatch overhead,
+    and the host round trip, none of which are per-token costs. Each timed
+    value is forced with a scalar device_get."""
+    import dataclasses
+
     from accelerate_tpu.generation import generate
     from accelerate_tpu.models import DecoderLM
     from accelerate_tpu.parallel.sharding import unbox_params
 
+    # one explicit cache size for BOTH loop lengths, so the differential
+    # really cancels per-call costs instead of comparing two cache buckets
+    cfg = dataclasses.replace(
+        cfg, max_cache_len=min(cfg.max_seq_len, -(-(prompt_len + base_tokens + extra_tokens) // 256) * 256)
+    )
     model_def = DecoderLM(cfg)
     variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len)
     params, _ = unbox_params(variables["params"])
-    params = jax.device_put(params)
+    params = jax.device_put(
+        jax.tree_util.tree_map(lambda x: x.astype(cfg.dtype), params)
+    )
     ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (1, prompt_len))
 
-    out = generate(model_def, params, ids, max_new_tokens=new_tokens)  # compile
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    out = generate(model_def, params, ids, max_new_tokens=new_tokens)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    return dt / new_tokens
+    def run(n):
+        out = generate(model_def, params, ids, max_new_tokens=n)
+        return int(jax.device_get(out[0, -1]))  # forces the whole loop
+
+    run(base_tokens)  # compile both loop lengths
+    run(base_tokens + extra_tokens)
+    timings = []
+    for _ in range(2):
+        t0 = time.perf_counter(); run(base_tokens); t_base = time.perf_counter() - t0
+        t0 = time.perf_counter(); run(base_tokens + extra_tokens); t_full = time.perf_counter() - t0
+        timings.append((t_full - t_base) / extra_tokens)
+    return float(np.median(timings))
 
 
 def main():
@@ -148,9 +232,21 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--fp8", action="store_true",
                         help="Also run the flagship config under the fp8 recipe and report its MFU")
+    parser.add_argument("--_ttft_worker", nargs=3, metavar=("CFG", "PROMPT", "DIR"),
+                        help="internal: run one TTFT attempt and print it")
     args, _ = parser.parse_known_args()
 
     on_tpu = jax.default_backend() == "tpu"
+
+    if args._ttft_worker:
+        name, prompt, tmpdir = args._ttft_worker
+        cfg = _named_configs(on_tpu)[name]
+        import os
+
+        ckpt = os.path.join(tmpdir, "model.safetensors")
+        print(f"TTFT {_ttft_once(cfg, ckpt, int(prompt)):.3f}")
+        return
+
     extra = {}
 
     if on_tpu:
@@ -172,9 +268,9 @@ def main():
         extra["gqa_train_mfu_pct"] = round(gqa_mfu * 100, 2)
         extra["gqa_tokens_per_sec"] = round(gqa_tok_s)
 
-        # long-context: 16k tokens single chip (ring attention exercises the
-        # sequence axis only multi-chip; single-chip this stresses the flash
-        # kernel's long-S path + remat)
+        # long-context: 16k and 32k tokens single chip (ring attention
+        # exercises the sequence axis only multi-chip; single-chip this
+        # stresses the flash kernel's long-S path + remat)
         longctx = DecoderConfig(
             vocab_size=32_000, num_layers=8, embed_dim=1024, num_heads=8,
             num_kv_heads=8, mlp_dim=2816, max_seq_len=16_384,
@@ -184,6 +280,15 @@ def main():
         extra["long16k_train_mfu_pct"] = round(lc_mfu * 100, 2)
         extra["long16k_tokens_per_sec"] = round(lc_tok_s)
 
+        long32k = DecoderConfig(
+            vocab_size=32_000, num_layers=8, embed_dim=1024, num_heads=8,
+            num_kv_heads=8, mlp_dim=2816, max_seq_len=32_768,
+            dtype=jnp.bfloat16, remat=True, scan_layers=True,
+        )
+        lc32_tok_s, lc32_mfu, _, _ = _train_bench(long32k, 1, 32_768, 3, "bf16")
+        extra["long32k_train_mfu_pct"] = round(lc32_mfu * 100, 2)
+        extra["long32k_tokens_per_sec"] = round(lc32_tok_s)
+
         if args.fp8:
             fp8_tok_s, fp8_mfu, _, _ = _train_bench(flagship, 8, 2048, 10, "fp8")
             extra["fp8_train_mfu_pct"] = round(fp8_mfu * 100, 2)
@@ -191,23 +296,28 @@ def main():
 
         import tempfile
 
-        ttft_cfg = DecoderConfig(
-            vocab_size=32_000, num_layers=12, embed_dim=1536, num_heads=12,
-            num_kv_heads=12, mlp_dim=4096, max_seq_len=2048,
-            dtype=jnp.bfloat16, remat=False, scan_layers=True,
-        )
+        ttft_cfg = _named_configs(True)["ttft_390m"]
         with tempfile.TemporaryDirectory() as td:
-            extra["dispatch_ttft_s"] = round(_ttft_bench(ttft_cfg, 128, td), 2)
-        extra["decode_ms_per_token"] = round(_decode_bench(ttft_cfg, 128, 64) * 1e3, 2)
+            _write_host_checkpoint(ttft_cfg, 128, td)
+            p50, tries = _ttft_bench("ttft_390m", 128, td)
+        # the tunnel link's throughput varies ~100x over minutes; best-of-N
+        # is the framework number, the attempts list shows the spread
+        extra["dispatch_ttft_s"] = round(p50, 2)
+        extra["dispatch_ttft_best_s"] = round(min(tries), 2)
+        extra["dispatch_ttft_attempts"] = [round(t, 2) for t in tries]
+        extra["decode_ms_per_token"] = round(_decode_bench(ttft_cfg, 128) * 1e3, 2)
     else:
         cfg = DecoderConfig.tiny(max_seq_len=256)
         tok_s, mfu, _, step_ms = _train_bench(cfg, 4, 128, 5, "no")
         import tempfile
 
+        tiny = _named_configs(False)["ttft_tiny"]
         with tempfile.TemporaryDirectory() as td:
-            extra["dispatch_ttft_s"] = round(_ttft_bench(DecoderConfig.tiny(), 32, td), 2)
+            _write_host_checkpoint(tiny, 32, td)
+            p50, _tries = _ttft_bench("ttft_tiny", 32, td, attempts=1)
+        extra["dispatch_ttft_s"] = round(p50, 2)
         extra["decode_ms_per_token"] = round(
-            _decode_bench(DecoderConfig.tiny(max_seq_len=128), 32, 16) * 1e3, 2
+            _decode_bench(DecoderConfig.tiny(max_seq_len=128), 32, base_tokens=4, extra_tokens=16) * 1e3, 2
         )
 
     print(
