@@ -244,7 +244,11 @@ class DispatchedModel:
         )
         a_args, a_kw = to_aval(traced_args), to_aval(traced_kw)
         compiled = jitted.lower(abstract, a_args, a_kw, static_args, static_kw).compile()
-        self._aot[(key, self._aval_key((abstract, a_args, a_kw)), static_args, static_kw)] = compiled
+        # params avals are excluded from the key: they are determined by the
+        # placement key, and walking every param leaf per call would put
+        # O(num_params) Python work on the dispatch hot path; a placement
+        # drift surfaces as TypeError/ValueError and falls back to jit
+        self._aot[(key, self._aval_key((a_args, a_kw)), static_args, static_kw)] = compiled
         return self
 
     def __call__(self, *args, **kwargs):
@@ -261,8 +265,8 @@ class DispatchedModel:
         except TypeError:
             return apply(params, traced_args, traced_kw, static_args, static_kw)
         aot = None
-        if self._aot:  # skip the per-leaf key build entirely for non-AOT users
-            aot = self._aot.get((key, self._aval_key((params, traced_args, traced_kw)),
+        if self._aot:  # skip the key build entirely for non-AOT users
+            aot = self._aot.get((key, self._aval_key((traced_args, traced_kw)),
                                  static_args, static_kw))
         if aot is not None:
             try:

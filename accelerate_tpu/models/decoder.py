@@ -58,6 +58,16 @@ def _maybe_streaming(body, cfg):
     return body
 
 
+def _remat_policy(cfg):
+    """jax.checkpoint policy for the block remat. "save_attention" keeps the
+    flash kernel's named residuals (ops/attention.py checkpoint_name) so the
+    backward pass reuses out/lse instead of re-running the kernel — the
+    dominant recompute term at long context."""
+    if getattr(cfg, "remat_policy", "full") == "save_attention":
+        return jax.checkpoint_policies.save_only_these_names("flash_out", "flash_lse")
+    return None
+
+
 class DecoderAttention(nn.Module):
     """``use_cache`` turns on the KV cache (a mutable "cache" collection):
     the prefill pass (decode=False) writes the prompt's K/V at [0:s] and
@@ -208,7 +218,7 @@ class StageStack(nn.Module):
         cfg = self.config
         body = _ScanBlock
         if cfg.remat:
-            body = nn.remat(body, prevent_cse=False, static_argnums=())
+            body = nn.remat(body, prevent_cse=False, static_argnums=(), policy=_remat_policy(cfg))
         Stack = nn.scan(
             body,
             variable_axes={"params": 0},
@@ -318,6 +328,7 @@ class DecoderLM(nn.Module):
                     scan_body,
                     prevent_cse=False,
                     static_argnums=(),
+                    policy=_remat_policy(cfg),
                 )
             ScanStack = nn.scan(
                 scan_body,
@@ -332,7 +343,7 @@ class DecoderLM(nn.Module):
         else:
             block_cls = _maybe_streaming(DecoderBlock, cfg)
             if cfg.remat:
-                block_cls = nn.remat(block_cls, prevent_cse=True)
+                block_cls = nn.remat(block_cls, prevent_cse=True, policy=_remat_policy(cfg))
             for i in range(cfg.num_layers):
                 x, block_aux = block_cls(cfg, self.mesh, use_cache, decode, name=f"layer_{i}")(
                     x, sin, cos, deterministic

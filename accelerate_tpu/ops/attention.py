@@ -474,8 +474,16 @@ def _flash_core(q, k, v, masks, causal, sm_scale, bq, bk, interpret):
 
 def _flash_core_fwd(q, k, v, masks, causal, sm_scale, bq, bk, interpret):
     out, lse = _flash_fwd_call(q, k, v, masks, causal, sm_scale, bq, bk, interpret)
-    # keep only the value row of the [B,H,8,Sq] tile layout as the residual
-    return out, (q, k, v, masks, out, lse[:, :, :1])
+    # keep only the value row of the [B,H,8,Sq] tile layout as the residual.
+    # checkpoint_name lets a remat policy (models/configs.remat_policy =
+    # "save_attention") KEEP these residuals so the backward pass reuses the
+    # kernel's out/lse instead of re-running the whole forward kernel —
+    # at 16k+ tokens the attention recompute is the largest remat term.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse[:, :, :1], "flash_lse")
+    return out, (q, k, v, masks, out, lse)
 
 
 def _flash_core_bwd(causal, sm_scale, bq, bk, interpret, res, do):

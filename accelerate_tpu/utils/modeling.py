@@ -345,7 +345,9 @@ def load_checkpoint_in_model(
     out: dict[str, Any] = {}
     for path, abstract in flat_abstract.items():
         value = np.asarray(flat_loaded[path])
-        if dtype is not None and np.issubdtype(value.dtype, np.floating):
+        # jnp.issubdtype, not np: ml_dtypes bf16 is floating too (and the
+        # dispatch AOT precompile predicts the cast with the same predicate)
+        if dtype is not None and jnp.issubdtype(jnp.dtype(value.dtype), jnp.floating):
             value = value.astype(dtype)
         tier = placement_of(path, device_map)
         if tier == "device":

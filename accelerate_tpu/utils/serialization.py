@@ -464,7 +464,9 @@ def _load_safetensors(path: str, zero_copy: bool | None = None) -> dict[str, np.
         with open(path, "rb") as f:
             for off, arr in zip(offsets, dests):
                 f.seek(off)
-                f.readinto(memoryview(arr).cast("B"))
+                # uint8 view: ml_dtypes arrays (bf16) reject the buffer
+                # protocol directly
+                f.readinto(arr.view(np.uint8).reshape(-1))
     return dict(zip(names, dests))
 
 
