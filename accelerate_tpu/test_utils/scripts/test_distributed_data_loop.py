@@ -159,6 +159,32 @@ def test_skip_first_batches(accelerator, n_samples, batch_size):
     accelerator.print("skip_first_batches OK")
 
 
+def test_even_batches_off(accelerator, batch_size):
+    """even_batches=False: NO wraparound — the union over ranks is exactly
+    the dataset (reference test_distributed_data_loop uneven matrix); ranks
+    may legitimately iterate different counts."""
+    from accelerate_tpu.data import DataLoader, prepare_data_loader
+    from accelerate_tpu.utils.operations import gather_object
+
+    n = accelerator.num_processes
+    n_samples = batch_size * n * 2 + 3  # ragged tail
+    dl = DataLoader(ArangeDataset(n_samples), batch_size=batch_size)
+    dl = prepare_data_loader(
+        dl,
+        mesh=accelerator.mesh,
+        even_batches=False,
+        put_on_device=False,
+        use_seedable_sampler=False,
+    )
+    local = []
+    for batch in dl:
+        local += np.asarray(batch["x"])[:, 0].astype(int).tolist()
+    everyone = gather_object([local])
+    seen = sorted(v for rank_items in everyone for v in rank_items)
+    assert seen == list(range(n_samples)), (seen[:10], n_samples)
+    accelerator.print("even_batches=False exact cover OK")
+
+
 def main():
     from accelerate_tpu import Accelerator
 
@@ -172,6 +198,7 @@ def main():
     test_dispatch_mode(accelerator, bs * world * 4, bs)
     test_dispatch_ragged_tail(accelerator, bs)
     test_dispatch_local_slice(accelerator, bs)
+    test_even_batches_off(accelerator, bs)
     test_split_batches(accelerator, 8 * world * 2)
     test_skip_first_batches(accelerator, bs * world * 4, bs)
     from accelerate_tpu.state import PartialState

@@ -72,11 +72,15 @@ def test_sync_flag_pattern(accelerator_factory, accum_steps: int):
     accelerator.print(f"sync flag pattern OK (accum={accum_steps}, {sum(expected)} steps)")
 
 
-def test_sync_each_batch(accelerator_factory):
+def test_sync_each_batch(accelerator_factory, accum_steps: int = 4):
+    """sync_each_batch=True forces a grad sync on EVERY batch regardless of
+    the accumulation window (reference test_sync.py:207-404 matrix rows)."""
     from accelerate_tpu import GradientAccumulationPlugin
 
     accelerator = accelerator_factory(
-        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=4, sync_each_batch=True)
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=accum_steps, sync_each_batch=True
+        )
     )
     model, optimizer, dl = _setup(accelerator, length=32, batch_size=8)
     flags = []
@@ -87,9 +91,9 @@ def test_sync_each_batch(accelerator_factory):
             flags.append(accelerator.sync_gradients)
             optimizer.step()
             optimizer.zero_grad()
-    assert all(flags), flags
+    assert all(flags), (accum_steps, flags)
     _assert_params_synced(accelerator, model)
-    accelerator.print("sync_each_batch OK")
+    accelerator.print(f"sync_each_batch OK (accum={accum_steps})")
 
 
 def test_dataloader_end_forces_sync(accelerator_factory):
@@ -158,7 +162,8 @@ def main():
     factory = _fresh_accelerator
     for accum in (1, 2, 3):
         test_sync_flag_pattern(factory, accum)
-    test_sync_each_batch(factory)
+    for accum in (2, 4):  # the sync_each_batch x accum matrix rows
+        test_sync_each_batch(factory, accum)
     test_dataloader_end_forces_sync(factory)
     test_accumulation_matches_big_batch(factory)
     test_no_sync_suppresses_update(factory)

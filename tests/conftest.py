@@ -17,6 +17,28 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
+# The suite is compile-bound (hundreds of tiny GSPMD programs on one CPU
+# core). Two levers keep wall time sane; both are overridable:
+# - skip XLA's optimization pipeline: tests assert semantics, not speed
+#   (~35-65% off the worst tests' compile time)
+# - persist compiled executables across runs in a repo-local cache, so
+#   re-runs (CI retries, local iteration, review) skip backend compiles
+os.environ.setdefault("JAX_DISABLE_MOST_OPTIMIZATIONS", "1")
+if os.environ.get("ATT_TEST_XLA_CACHE", "1").lower() not in ("0", "false", ""):
+    _cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
+    os.environ.setdefault("ATT_COMPILE_CACHE", _cache_dir)
+    # env (not jax.config.update) so LAUNCHED SUBPROCESSES — the most
+    # compile-heavy tests — inherit the cache too
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+    def _enable_test_compile_cache():
+        os.makedirs(_cache_dir, exist_ok=True)
+else:
+    def _enable_test_compile_cache():
+        pass
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if "jax" in sys.modules:
@@ -30,6 +52,8 @@ if "jax" in sys.modules:
     )
 
 import pytest  # noqa: E402
+
+_enable_test_compile_cache()
 
 
 @pytest.fixture(autouse=True)

@@ -13,6 +13,20 @@ from accelerate_tpu.test_utils.testing import (
 
 
 @pytest.mark.slow
+class TestLaunchedScriptMatrix:
+    """The full distributed assertion matrix (reference test_script.py:87-732
+    analog) under real multi-process launches at 2 and 4 processes."""
+
+    def test_matrix_two_processes(self):
+        r = run_launched_script(("test_utils", "scripts", "test_script.py"), num_processes=2)
+        assert "ALL CHECKS PASSED" in r.stdout
+
+    def test_matrix_four_processes(self):
+        r = run_launched_script(("test_utils", "scripts", "test_script.py"), num_processes=4)
+        assert "ALL CHECKS PASSED" in r.stdout
+
+
+@pytest.mark.slow
 class TestLaunchedOps:
     def test_ops_two_processes(self):
         r = run_launched_script(("test_utils", "scripts", "test_ops.py"), num_processes=2)
