@@ -2,12 +2,15 @@
 (parity: reference commands/estimate.py:309 — meta-load + dtype table incl.
 training with Adam x4; TPU version adds per-chip fit given a mesh size).
 
-Sources: a built-in model preset (decoder:small_1b etc.), a local
-checkpoint (safetensors/sharded), or explicit --params count. Zero-egress:
-no Hub downloads."""
+Sources: a built-in model preset (decoder:small_1b etc.), ANY local
+safetensors checkpoint — single file, sharded index, or per-rank
+distributed — read header-only (shapes/dtypes, zero tensor bytes, the
+meta-load analog of reference estimate.py:63), or an explicit --params
+count. Zero-egress: no Hub downloads."""
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 
@@ -24,7 +27,35 @@ def register(subparsers):
     return parser
 
 
-def _num_params(model: str) -> tuple[int, str]:
+def _inspect_checkpoint(path: str):
+    """Header-only inspection of any safetensors checkpoint: (param count,
+    {stored dtype: bytes}, largest top-level group bytes). No tensor data is
+    read — a 70B checkpoint inspects in milliseconds."""
+    import numpy as np
+
+    from ..utils.serialization import load_flat_dict, peek_flat_structs
+
+    structs = peek_flat_structs(path)
+    if structs is None:  # pickle or exotic format: fall back to a real load
+        structs = load_flat_dict(path)
+    n = 0
+    by_dtype: dict[str, int] = {}
+    groups: dict[str, int] = {}
+    for key, leaf in structs.items():
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        nbytes = int(size * np.dtype(leaf.dtype).itemsize)
+        n += size
+        name = np.dtype(leaf.dtype).name
+        by_dtype[name] = by_dtype.get(name, 0) + nbytes
+        top = key.split("/")[0].split(".")[0]
+        groups[top] = groups.get(top, 0) + nbytes
+    largest = max(groups.values()) if groups else 0
+    return n, by_dtype, largest
+
+
+def _num_params(model: str):
+    """Returns (param count, display name, largest-group bytes | None,
+    stored-dtype byte map | None)."""
     if ":" in model and not os.path.exists(model):
         family, preset = model.split(":", 1)
         if family == "decoder":
@@ -33,12 +64,13 @@ def _num_params(model: str) -> tuple[int, str]:
             cfg = getattr(DecoderConfig, preset)() if hasattr(DecoderConfig, preset) else None
             if cfg is None:
                 raise SystemExit(f"unknown decoder preset {preset!r}")
-            return cfg.num_params, model
+            return cfg.num_params, model, None, None
         if family == "encoder":
-            from ..models import EncoderClassifier, EncoderConfig
             import jax
             import jax.numpy as jnp
             import numpy as np
+
+            from ..models import EncoderClassifier, EncoderConfig
 
             cfg = getattr(EncoderConfig, preset)() if hasattr(EncoderConfig, preset) else None
             if cfg is None:
@@ -47,19 +79,20 @@ def _num_params(model: str) -> tuple[int, str]:
                 lambda: EncoderClassifier(cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
             )
             n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(abstract))
-            return n, model
+            return n, model, None, None
         raise SystemExit(f"unknown model family {family!r}")
-    if os.path.exists(model):
-        from ..utils.serialization import load_flat_dict
-        import numpy as np
-
-        flat = load_flat_dict(model)
-        return sum(int(np.prod(v.shape)) for v in flat.values()), model
-    # "7B" / "350M" style
+    exists = (
+        os.path.exists(model)
+        or os.path.exists(model + ".index.json")
+        or glob.glob(model + ".rank*.manifest.json")
+    )
+    if exists:
+        n, by_dtype, largest = _inspect_checkpoint(model)
+        return n, model, largest, by_dtype
     suffixes = {"K": 1e3, "M": 1e6, "B": 1e9, "T": 1e12}
     s = model.upper().rstrip()
     if s and s[-1] in suffixes:
-        return int(float(s[:-1]) * suffixes[s[-1]]), model
+        return int(float(s[:-1]) * suffixes[s[-1]]), model, None, None
     raise SystemExit(f"cannot interpret model spec {model!r}")
 
 
@@ -72,26 +105,36 @@ def _fmt(n_bytes: float) -> str:
 
 
 def estimate_command(args) -> int:
-    n, name = _num_params(args.model)
+    n, name, largest, by_dtype = _num_params(args.model)
     rows = []
     for dtype in args.dtypes:
         weights = n * DTYPE_BYTES[dtype]
         # training: params + grads (same dtype) + Adam m/v in fp32 + fp32 master
         train = weights + n * DTYPE_BYTES[dtype] + n * 4 * 2 + (n * 4 if dtype != "float32" else 0)
-        rows.append(
-            {
-                "dtype": dtype,
-                "params": n,
-                "inference_total": weights,
-                "training_total_adam": train,
-                "inference_per_chip": weights / args.num_chips,
-                "training_per_chip_fsdp": train / args.num_chips,
-            }
-        )
+        row = {
+            "dtype": dtype,
+            "params": n,
+            "inference_total": weights,
+            "training_total_adam": train,
+            "inference_per_chip": weights / args.num_chips,
+            "training_per_chip_fsdp": train / args.num_chips,
+        }
+        if largest is not None:
+            # peak-host invariant: the biggest module group that must be
+            # resident while streaming (reference README.md:43-45)
+            row["largest_group"] = largest
+        rows.append(row)
     if args.as_json:
-        print(json.dumps({"model": name, "rows": rows}))
+        out = {"model": name, "rows": rows}
+        if by_dtype is not None:
+            out["checkpoint_dtypes"] = by_dtype
+            out["largest_group_bytes"] = largest
+        print(json.dumps(out))
         return 0
     print(f"Memory estimate for {name} ({n/1e6:,.0f}M params, mesh of {args.num_chips} chip(s))")
+    if by_dtype is not None:
+        stored = ", ".join(f"{k}: {_fmt(v)}" for k, v in sorted(by_dtype.items()))
+        print(f"checkpoint stores: {stored}; largest module group {_fmt(largest)}")
     header = f"{'dtype':>9} | {'inference':>12} | {'train (Adam)':>13} | {'infer/chip':>12} | {'train/chip':>12}"
     print(header)
     print("-" * len(header))

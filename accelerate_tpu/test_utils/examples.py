@@ -1,0 +1,134 @@
+"""Canon-diff machinery for the examples suite.
+
+Parity target: reference test_utils/examples.py (compare_against_test) +
+tests/test_examples.py:290 — every `examples/by_feature/*.py` script must be
+the canonical example plus clearly-fenced feature additions, so a user can
+diff any feature script against the canon and see ONLY that feature.
+
+Contract enforced here:
+- feature scripts mark additions with a `# New Code #` comment line and
+  close them with `# End New Code #` (the reference's marker convention,
+  made explicit with an end fence);
+- outside those fences, a feature script may only contain lines that are
+  already in the canon (plus blanks/comments/import shuffles);
+- the bulk of the canon's training loop must survive into the feature
+  script (it is the same lesson, extended).
+"""
+
+from __future__ import annotations
+
+import difflib
+import re
+from pathlib import Path
+
+_FENCE_OPEN = re.compile(r"#\s*New Code\s*#?", re.IGNORECASE)
+_FENCE_CLOSE = re.compile(r"#\s*End New Code\s*#?", re.IGNORECASE)
+
+
+def _region(path: str | Path, start_marker: str = "def training_function",
+            end_marker: str = "def main") -> list[str]:
+    """The comparable region of an example: the training function only
+    (docstring/imports/argparse legitimately differ — the reference's
+    checker likewise scopes to the training body)."""
+    text = Path(path).read_text()
+    lines = text.splitlines()
+    start = 0
+    for i, line in enumerate(lines):
+        if line.startswith(start_marker):
+            start = i
+            break
+    end = len(lines)
+    for i in range(start + 1, len(lines)):
+        if lines[i].startswith(end_marker):
+            end = i
+            break
+    return lines[start:end]
+
+
+def _normalize(line: str) -> str:
+    return line.strip()
+
+
+def _is_noise(line: str) -> bool:
+    s = line.strip()
+    return not s or s.startswith("#")
+
+
+def _fenced_mask(lines: list[str]) -> list[bool]:
+    """True for lines inside a New Code fence (fence comments included)."""
+    mask, depth = [], 0
+    for line in lines:
+        opens = bool(_FENCE_OPEN.search(line)) and not _FENCE_CLOSE.search(line)
+        closes = bool(_FENCE_CLOSE.search(line))
+        if opens:
+            depth += 1
+            mask.append(True)
+            continue
+        if closes:
+            mask.append(True)
+            depth = max(0, depth - 1)
+            continue
+        mask.append(depth > 0)
+    return mask
+
+
+def fence_violations(canon_path: str | Path, feature_path: str | Path) -> list[tuple[int, str]]:
+    """Lines ADDED relative to the canon that are not inside a New Code
+    fence. Empty list = the feature script is canon + fenced additions."""
+    canon = [_normalize(l) for l in _region(canon_path)]
+    feature_lines = _region(feature_path)
+    feature = [_normalize(l) for l in feature_lines]
+    mask = _fenced_mask(feature_lines)
+    canon_set = set(l for l in canon if not _is_noise(l))
+
+    feature_set = set(l for l in feature if not _is_noise(l))
+
+    def _near_fence(j, window=3):
+        lo, hi = max(0, j - window), min(len(mask), j + window + 1)
+        return any(mask[k] for k in range(lo, hi))
+
+    violations = []
+    sm = difflib.SequenceMatcher(a=canon, b=feature, autojunk=False)
+    for tag, i1, i2, j1, j2 in sm.get_opcodes():
+        if tag in ("delete", "replace"):
+            # canon behavior may only disappear next to a fenced
+            # replacement — a bare deletion silently drops the lesson
+            # (e.g. losing the gradient-accumulation step guard)
+            for i in range(i1, i2):
+                line = canon[i]
+                if _is_noise(line) or line in feature_set:
+                    continue
+                if _near_fence(min(j1, len(mask) - 1)):
+                    continue
+                violations.append((j1 + 1, f"<canon line removed: {line}>"))
+        if tag not in ("insert", "replace"):
+            continue
+        for j in range(j1, j2):
+            line = feature[j]
+            if _is_noise(line) or mask[j]:
+                continue
+            # moved (not new) lines are fine — the canon contains them
+            if line in canon_set:
+                continue
+            violations.append((j + 1, feature_lines[j]))
+    depth = 0
+    for line in feature_lines:
+        if _FENCE_OPEN.search(line) and not _FENCE_CLOSE.search(line):
+            depth += 1
+        elif _FENCE_CLOSE.search(line):
+            depth = max(0, depth - 1)
+    if depth != 0:
+        # an unbalanced fence would mask the whole tail of the file
+        violations.append((len(feature_lines), "<unclosed '# New Code #' fence>"))
+    return violations
+
+
+def canon_coverage(canon_path: str | Path, feature_path: str | Path) -> float:
+    """Fraction of the canon's substantive lines present in the feature
+    script — guards against a feature example drifting into a rewrite."""
+    canon = [_normalize(l) for l in _region(canon_path) if not _is_noise(l)]
+    feature = set(_normalize(l) for l in _region(feature_path) if not _is_noise(l))
+    if not canon:
+        return 1.0
+    hit = sum(1 for l in canon if l in feature)
+    return hit / len(canon)

@@ -1,0 +1,148 @@
+"""By-feature example: automatic gradient accumulation.
+
+Mirrors the reference feature example
+(/root/reference/examples/by_feature/automatic_gradient_accumulation.py):
+combine `find_executable_batch_size` with gradient accumulation so the
+script adapts to whatever HBM the chip has. Start from the OBSERVED batch
+size the user wants; if the step OOMs, the decorator halves the per-chip
+batch and raises the accumulation count to keep the effective batch — and
+therefore the training math — identical.
+
+Diff this file against examples/nlp_example.py: the `# New Code #` fences
+contain the entire feature.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, DataLoader, Model
+from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+from accelerate_tpu.utils.random import set_seed
+
+# New Code #
+from accelerate_tpu.utils.memory import find_executable_batch_size
+# End New Code #
+
+# reuse the MRPC-shaped synthetic data + loader wiring from the base example
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+MAX_CHIP_BATCH_SIZE = 16
+
+
+def training_function(config, args):
+    lr = config["lr"]
+    num_epochs = int(config["num_epochs"])
+    seed = int(config["seed"])
+    # New Code #
+    # the batch the user WANTS (the observed/effective batch)
+    observed_batch_size = int(config["batch_size"])
+
+    @find_executable_batch_size(starting_batch_size=observed_batch_size)
+    def inner_training_loop(batch_size):
+        # everything rebuilt per attempt: a halved batch means a fresh
+        # Accelerator with the matching accumulation count
+        accumulation = max(1, observed_batch_size // batch_size)
+        accelerator = Accelerator(
+            mixed_precision=args.mixed_precision,
+            gradient_accumulation_steps=accumulation,
+        )
+        accelerator.print(f"trying per-chip batch {batch_size} x accum {accumulation}")
+        # End New Code #
+
+        set_seed(seed)
+        model_config = EncoderConfig.tiny() if args.cpu or args.tiny else EncoderConfig.bert_base()
+        train_dataloader, eval_dataloader = get_dataloaders(
+            accelerator, batch_size, model_config,
+            train_len=config.get("train_len", 512), eval_len=config.get("eval_len", 128),
+        )
+
+        model_def = EncoderClassifier(model_config, mesh=accelerator.mesh)
+        variables = model_def.init_variables(
+            jax.random.PRNGKey(seed), batch_size=batch_size, seq_len=min(model_config.max_seq_len, 128)
+        )
+        # New Code #
+        total_steps = (len(train_dataloader) * num_epochs) // accumulation
+        # End New Code #
+        warmup = min(100, max(total_steps // 10, 1))
+        lr_schedule = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, max(total_steps, warmup + 1))
+
+        model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+            Model(model_def, variables), optax.adamw(lr_schedule), train_dataloader, eval_dataloader, lr_schedule
+        )
+
+        for epoch in range(num_epochs):
+            model.train()
+            for step, batch in enumerate(train_dataloader):
+                # New Code #
+                # accumulate() gates the optimizer step + grad sync to fire
+                # once per effective batch, whatever per-chip size survived
+                with accelerator.accumulate(model):
+                    # End New Code #
+                    outputs = model(
+                        batch["input_ids"],
+                        attention_mask=batch["attention_mask"],
+                        token_type_ids=batch["token_type_ids"],
+                        labels=batch["labels"],
+                        deterministic=False,
+                    )
+                    loss = outputs["loss"]
+                    accelerator.backward(loss)
+                    # New Code #
+                    # no manual `if step % accumulation` gate: the
+                    # accumulate() context above owns the step cadence
+                    optimizer.step()
+                    lr_scheduler.step()
+                    optimizer.zero_grad()
+                    # End New Code #
+
+            model.eval()
+            correct = total = 0
+            for step, batch in enumerate(eval_dataloader):
+                outputs = model(
+                    batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
+                )
+                predictions = outputs["logits"].argmax(axis=-1)
+                predictions, references = accelerator.gather_for_metrics((predictions, batch["labels"]))
+                correct += int((np.asarray(predictions) == np.asarray(references)).sum())
+                total += int(np.asarray(references).shape[0])
+            accelerator.print(f"epoch {epoch}: {{'accuracy': {correct / max(total, 1):.4f}}}")
+
+        accelerator.end_training()
+        # New Code #
+
+    inner_training_loop()
+    # End New Code #
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Automatic gradient accumulation example.")
+    parser.add_argument(
+        "--mixed_precision",
+        type=str,
+        default=None,
+        choices=["no", "fp16", "bf16"],
+        help="Whether to use mixed precision (bf16 is the TPU-native choice).",
+    )
+    parser.add_argument("--cpu", action="store_true", help="Run the tiny config on CPU.")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model/dataset (CI).")
+    parser.add_argument("--num_epochs", type=int, default=None)
+    args = parser.parse_args()
+    config = {"lr": 2e-5, "num_epochs": args.num_epochs or 3, "seed": 42, "batch_size": 16}
+    if args.tiny or args.cpu:
+        config.update({"train_len": 128, "eval_len": 64})
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

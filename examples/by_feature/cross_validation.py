@@ -1,0 +1,202 @@
+"""By-feature example: k-fold cross validation.
+
+Mirrors the reference feature example
+(/root/reference/examples/by_feature/cross_validation.py): train k models
+on k train/validation splits, evaluate each on the SAME held-out test set,
+and average the per-fold test predictions into an ensemble metric. The
+distributed care points: every process must build identical folds (seeded
+split before sharding), and per-fold metrics must be gathered with
+`gather_for_metrics` so the ensemble math sees full, dedup'd arrays.
+
+Diff this file against examples/nlp_example.py: the `# New Code #` fences
+contain the entire feature.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, DataLoader, Model
+from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+from accelerate_tpu.utils.random import set_seed
+
+# reuse the MRPC-shaped synthetic data + loader wiring from the base example
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import EVAL_BATCH_SIZE, ParaphraseDataset  # noqa: E402
+
+MAX_CHIP_BATCH_SIZE = 16
+
+
+# New Code #
+class _Subset:
+    def __init__(self, ds, idx):
+        self.ds, self.idx = ds, list(idx)
+
+    def __len__(self):
+        return len(self.idx)
+
+    def __getitem__(self, i):
+        return self.ds[self.idx[i]]
+
+
+def get_fold_dataloaders(accelerator, batch_size, model_config, fold, num_folds,
+                         train_len=512, test_len=128):
+    """Identical seeded folds on every process: fold f validates on slice f
+    of the training pool and trains on the rest; the test set is shared."""
+    seq_len = min(model_config.max_seq_len, 128)
+    with accelerator.main_process_first():
+        pool = ParaphraseDataset(train_len, seq_len, model_config.vocab_size, seed=42)
+        test_ds = ParaphraseDataset(test_len, seq_len, model_config.vocab_size, seed=43)
+    perm = np.random.RandomState(0).permutation(train_len)
+    folds = np.array_split(perm, num_folds)
+    valid_idx = folds[fold]
+    train_idx = np.concatenate([f for i, f in enumerate(folds) if i != fold])
+    train_dataloader = DataLoader(_Subset(pool, train_idx), batch_size=batch_size,
+                                  shuffle=True, drop_last=True)
+    valid_dataloader = DataLoader(_Subset(pool, valid_idx), batch_size=EVAL_BATCH_SIZE)
+    test_dataloader = DataLoader(test_ds, batch_size=EVAL_BATCH_SIZE)
+    return train_dataloader, valid_dataloader, test_dataloader
+# End New Code #
+
+
+def training_function(config, args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    lr = config["lr"]
+    num_epochs = int(config["num_epochs"])
+    seed = int(config["seed"])
+    batch_size = int(config["batch_size"])
+
+    # If the requested batch exceeds one chip's comfort zone, fall back to
+    # gradient accumulation (reference nlp_example.py:124-128)
+    gradient_accumulation_steps = 1
+    if batch_size > MAX_CHIP_BATCH_SIZE:
+        gradient_accumulation_steps = batch_size // MAX_CHIP_BATCH_SIZE
+        batch_size = MAX_CHIP_BATCH_SIZE
+
+    set_seed(seed)
+    model_config = EncoderConfig.tiny() if args.cpu or args.tiny else EncoderConfig.bert_base()
+
+    # New Code #
+    num_folds = int(args.num_folds)
+    test_len = config.get("eval_len", 128)
+    test_logit_sum = None
+    test_references = None
+    for fold in range(num_folds):
+        train_dataloader, valid_dataloader, test_dataloader = get_fold_dataloaders(
+            accelerator, batch_size, model_config, fold, num_folds,
+            train_len=config.get("train_len", 512), test_len=test_len,
+        )
+        # End New Code #
+
+        model_def = EncoderClassifier(model_config, mesh=accelerator.mesh)
+        variables = model_def.init_variables(
+            jax.random.PRNGKey(seed), batch_size=batch_size, seq_len=min(model_config.max_seq_len, 128)
+        )
+        total_steps = (len(train_dataloader) * num_epochs) // gradient_accumulation_steps
+        warmup = min(100, max(total_steps // 10, 1))
+        lr_schedule = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, max(total_steps, warmup + 1))
+
+        # New Code #
+        model, optimizer, train_dataloader, valid_dataloader, test_dataloader, lr_scheduler = (
+            accelerator.prepare(
+                Model(model_def, variables), optax.adamw(lr_schedule),
+                train_dataloader, valid_dataloader, test_dataloader, lr_schedule,
+            )
+        )
+        # End New Code #
+
+        for epoch in range(num_epochs):
+            model.train()
+            for step, batch in enumerate(train_dataloader):
+                outputs = model(
+                    batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
+                    labels=batch["labels"],
+                    deterministic=False,
+                )
+                loss = outputs["loss"]
+                accelerator.backward(loss)
+                if step % gradient_accumulation_steps == 0:
+                    optimizer.step()
+                    lr_scheduler.step()
+                    optimizer.zero_grad()
+
+            model.eval()
+            correct = total = 0
+            # New Code #
+            for step, batch in enumerate(valid_dataloader):
+                # End New Code #
+                outputs = model(
+                    batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
+                )
+                predictions = outputs["logits"].argmax(axis=-1)
+                predictions, references = accelerator.gather_for_metrics((predictions, batch["labels"]))
+                correct += int((np.asarray(predictions) == np.asarray(references)).sum())
+                total += int(np.asarray(references).shape[0])
+            # New Code #
+            accelerator.print(f"fold {fold} epoch {epoch}: "
+                              f"{{'valid_accuracy': {correct / max(total, 1):.4f}}}")
+
+        # this fold's vote on the shared test set
+        fold_logits, fold_refs = [], []
+        for batch in test_dataloader:
+            outputs = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+            )
+            logits, references = accelerator.gather_for_metrics(
+                (outputs["logits"], batch["labels"])
+            )
+            fold_logits.append(np.asarray(logits, np.float32))
+            fold_refs.append(np.asarray(references))
+        logits = np.concatenate(fold_logits)
+        if test_logit_sum is None:
+            test_logit_sum = logits
+            test_references = np.concatenate(fold_refs)
+        else:
+            test_logit_sum = test_logit_sum + logits
+
+    ensemble = test_logit_sum.argmax(axis=-1)
+    accuracy = float((ensemble == test_references).mean())
+    accelerator.print(f"{num_folds}-fold ensemble test accuracy: {accuracy:.4f} "
+                      f"on {test_references.shape[0]} examples")
+    # End New Code #
+
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="k-fold cross-validation example.")
+    parser.add_argument(
+        "--mixed_precision",
+        type=str,
+        default=None,
+        choices=["no", "fp16", "bf16"],
+        help="Whether to use mixed precision (bf16 is the TPU-native choice).",
+    )
+    parser.add_argument("--cpu", action="store_true", help="Run the tiny config on CPU.")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model/dataset (CI).")
+    parser.add_argument("--num_epochs", type=int, default=None)
+    # New Code #
+    parser.add_argument("--num_folds", type=int, default=3, help="Number of CV folds.")
+    # End New Code #
+    args = parser.parse_args()
+    config = {"lr": 2e-5, "num_epochs": args.num_epochs or 2, "seed": 42, "batch_size": 16}
+    if args.tiny or args.cpu:
+        config.update({"train_len": 128, "eval_len": 64})
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

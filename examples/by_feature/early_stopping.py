@@ -1,0 +1,166 @@
+"""By-feature example: early stopping.
+
+Mirrors the reference feature example
+(/root/reference/examples/by_feature/early_stopping.py): track the eval
+metric each epoch and stop when it hasn't improved for `--patience` epochs.
+
+The distributed subtlety (and the reason this is an Accelerate feature, not
+three lines of user code): the stop decision must be IDENTICAL on every
+process or the job deadlocks in a collective. `accelerator.set_trigger()` /
+`check_trigger()` reduce the flag across ranks so all processes break on
+the same epoch — any rank observing the plateau stops everyone.
+
+Diff this file against examples/nlp_example.py: the `# New Code #` fences
+contain the entire feature.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, DataLoader, Model
+from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+from accelerate_tpu.utils.random import set_seed
+
+# reuse the MRPC-shaped synthetic data + loader wiring from the base example
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+MAX_CHIP_BATCH_SIZE = 16
+
+
+# New Code #
+class EarlyStopper:
+    """Stops training when the tracked metric plateaus for `patience` epochs."""
+
+    def __init__(self, patience: int = 2, min_delta: float = 0.0):
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = -float("inf")
+        self.bad_epochs = 0
+
+    def should_stop(self, metric: float) -> bool:
+        if metric > self.best + self.min_delta:
+            self.best = metric
+            self.bad_epochs = 0
+        else:
+            self.bad_epochs += 1
+        return self.bad_epochs >= self.patience
+# End New Code #
+
+
+def training_function(config, args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    lr = config["lr"]
+    num_epochs = int(config["num_epochs"])
+    seed = int(config["seed"])
+    batch_size = int(config["batch_size"])
+
+    # If the requested batch exceeds one chip's comfort zone, fall back to
+    # gradient accumulation (reference nlp_example.py:124-128)
+    gradient_accumulation_steps = 1
+    if batch_size > MAX_CHIP_BATCH_SIZE:
+        gradient_accumulation_steps = batch_size // MAX_CHIP_BATCH_SIZE
+        batch_size = MAX_CHIP_BATCH_SIZE
+
+    set_seed(seed)
+    model_config = EncoderConfig.tiny() if args.cpu or args.tiny else EncoderConfig.bert_base()
+    train_dataloader, eval_dataloader = get_dataloaders(
+        accelerator, batch_size, model_config,
+        train_len=config.get("train_len", 512), eval_len=config.get("eval_len", 128),
+    )
+
+    model_def = EncoderClassifier(model_config, mesh=accelerator.mesh)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(seed), batch_size=batch_size, seq_len=min(model_config.max_seq_len, 128)
+    )
+    total_steps = (len(train_dataloader) * num_epochs) // gradient_accumulation_steps
+    warmup = min(100, max(total_steps // 10, 1))
+    lr_schedule = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, max(total_steps, warmup + 1))
+
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+        Model(model_def, variables), optax.adamw(lr_schedule), train_dataloader, eval_dataloader, lr_schedule
+    )
+
+    # New Code #
+    stopper = EarlyStopper(patience=int(args.patience))
+    # End New Code #
+
+    for epoch in range(num_epochs):
+        model.train()
+        for step, batch in enumerate(train_dataloader):
+            outputs = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                labels=batch["labels"],
+                deterministic=False,
+            )
+            loss = outputs["loss"]
+            accelerator.backward(loss)
+            if step % gradient_accumulation_steps == 0:
+                optimizer.step()
+                lr_scheduler.step()
+                optimizer.zero_grad()
+
+        model.eval()
+        correct = total = 0
+        for step, batch in enumerate(eval_dataloader):
+            outputs = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+            )
+            predictions = outputs["logits"].argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics((predictions, batch["labels"]))
+            correct += int((np.asarray(predictions) == np.asarray(references)).sum())
+            total += int(np.asarray(references).shape[0])
+        accelerator.print(f"epoch {epoch}: {{'accuracy': {correct / max(total, 1):.4f}}}")
+
+        # New Code #
+        # every process feeds the same gathered metric to its stopper, and
+        # the trigger reduction makes the break unanimous even if a rank
+        # ever computed a different local decision
+        if stopper.should_stop(correct / max(total, 1)):
+            accelerator.set_trigger()
+        if accelerator.check_trigger():
+            accelerator.print(f"early stopping at epoch {epoch} "
+                              f"(no improvement for {stopper.patience} epochs)")
+            break
+        # End New Code #
+
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Early-stopping example.")
+    parser.add_argument(
+        "--mixed_precision",
+        type=str,
+        default=None,
+        choices=["no", "fp16", "bf16"],
+        help="Whether to use mixed precision (bf16 is the TPU-native choice).",
+    )
+    parser.add_argument("--cpu", action="store_true", help="Run the tiny config on CPU.")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model/dataset (CI).")
+    parser.add_argument("--num_epochs", type=int, default=None)
+    # New Code #
+    parser.add_argument("--patience", type=int, default=2,
+                        help="Epochs without eval improvement before stopping.")
+    # End New Code #
+    args = parser.parse_args()
+    config = {"lr": 2e-5, "num_epochs": args.num_epochs or 3, "seed": 42, "batch_size": 16}
+    if args.tiny or args.cpu:
+        config.update({"train_len": 128, "eval_len": 64})
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""By-feature example: correct metrics across processes.
+
+Mirrors the reference feature example
+(/root/reference/examples/by_feature/multi_process_metrics.py): when eval
+runs data-parallel, each process only sees its shard, and the LAST batch of
+an epoch may contain wraparound duplicates added to keep batches even.
+`accelerator.gather_for_metrics(...)` gathers every process's predictions
+AND drops those duplicates, so the metric denominator is exactly
+`len(eval_set)` — naive `gather` would overcount.
+
+Diff this file against examples/nlp_example.py: the `# New Code #` fences
+contain the entire feature.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, DataLoader, Model
+from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+from accelerate_tpu.utils.random import set_seed
+
+# reuse the MRPC-shaped synthetic data + loader wiring from the base example
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+MAX_CHIP_BATCH_SIZE = 16
+
+
+def training_function(config, args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    lr = config["lr"]
+    num_epochs = int(config["num_epochs"])
+    seed = int(config["seed"])
+    batch_size = int(config["batch_size"])
+
+    # If the requested batch exceeds one chip's comfort zone, fall back to
+    # gradient accumulation (reference nlp_example.py:124-128)
+    gradient_accumulation_steps = 1
+    if batch_size > MAX_CHIP_BATCH_SIZE:
+        gradient_accumulation_steps = batch_size // MAX_CHIP_BATCH_SIZE
+        batch_size = MAX_CHIP_BATCH_SIZE
+
+    set_seed(seed)
+    model_config = EncoderConfig.tiny() if args.cpu or args.tiny else EncoderConfig.bert_base()
+    train_dataloader, eval_dataloader = get_dataloaders(
+        accelerator, batch_size, model_config,
+        train_len=config.get("train_len", 512), eval_len=config.get("eval_len", 128),
+    )
+
+    model_def = EncoderClassifier(model_config, mesh=accelerator.mesh)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(seed), batch_size=batch_size, seq_len=min(model_config.max_seq_len, 128)
+    )
+    total_steps = (len(train_dataloader) * num_epochs) // gradient_accumulation_steps
+    warmup = min(100, max(total_steps // 10, 1))
+    lr_schedule = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, max(total_steps, warmup + 1))
+
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+        Model(model_def, variables), optax.adamw(lr_schedule), train_dataloader, eval_dataloader, lr_schedule
+    )
+
+    for epoch in range(num_epochs):
+        model.train()
+        for step, batch in enumerate(train_dataloader):
+            outputs = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                labels=batch["labels"],
+                deterministic=False,
+            )
+            loss = outputs["loss"]
+            accelerator.backward(loss)
+            if step % gradient_accumulation_steps == 0:
+                optimizer.step()
+                lr_scheduler.step()
+                optimizer.zero_grad()
+
+        model.eval()
+        # New Code #
+        # accumulate per-batch arrays, gather once per batch; the dedup of
+        # the ragged last batch happens inside gather_for_metrics, driven by
+        # the dataloader's remainder bookkeeping
+        all_predictions, all_references = [], []
+        # End New Code #
+        for step, batch in enumerate(eval_dataloader):
+            outputs = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+            )
+            predictions = outputs["logits"].argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics((predictions, batch["labels"]))
+            # New Code #
+            all_predictions.append(np.asarray(predictions))
+            all_references.append(np.asarray(references))
+        predictions = np.concatenate(all_predictions)
+        references = np.concatenate(all_references)
+        # the denominator proves the dedup: exactly the eval set size, on
+        # every process, no matter how ragged the final batch was
+        assert references.shape[0] == config.get("eval_len", 64), references.shape
+        accuracy = float((predictions == references).mean())
+        accelerator.print(f"epoch {epoch}: {{'accuracy': {accuracy:.4f}, "
+                          f"'examples': {references.shape[0]}}}")
+        # End New Code #
+
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Multi-process metrics example.")
+    parser.add_argument(
+        "--mixed_precision",
+        type=str,
+        default=None,
+        choices=["no", "fp16", "bf16"],
+        help="Whether to use mixed precision (bf16 is the TPU-native choice).",
+    )
+    parser.add_argument("--cpu", action="store_true", help="Run the tiny config on CPU.")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model/dataset (CI).")
+    parser.add_argument("--num_epochs", type=int, default=None)
+    args = parser.parse_args()
+    config = {"lr": 2e-5, "num_epochs": args.num_epochs or 3, "seed": 42, "batch_size": 16}
+    if args.tiny or args.cpu:
+        config.update({"train_len": 128, "eval_len": 64})
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""By-feature example: schedule-free training.
+
+Mirrors the reference feature example
+(/root/reference/examples/by_feature/schedule_free.py): train with a
+schedule-free optimizer (Defazio et al. 2024) — no LR schedule, no horizon
+hyperparameter, and the `lr_scheduler.step()` line disappears from the
+loop. On the optax side this is `optax.contrib.schedule_free` wrapping a
+base optimizer; the one behavioral subtlety is that evaluation should use
+the averaged (x) parameters, obtained with
+`optax.contrib.schedule_free_eval_params(opt_state, params)`.
+
+Diff this file against examples/nlp_example.py: the `# New Code #` fences
+contain the entire feature.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, DataLoader, Model
+from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+from accelerate_tpu.utils.random import set_seed
+
+# reuse the MRPC-shaped synthetic data + loader wiring from the base example
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+MAX_CHIP_BATCH_SIZE = 16
+
+
+def training_function(config, args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    lr = config["lr"]
+    num_epochs = int(config["num_epochs"])
+    seed = int(config["seed"])
+    batch_size = int(config["batch_size"])
+
+    # If the requested batch exceeds one chip's comfort zone, fall back to
+    # gradient accumulation (reference nlp_example.py:124-128)
+    gradient_accumulation_steps = 1
+    if batch_size > MAX_CHIP_BATCH_SIZE:
+        gradient_accumulation_steps = batch_size // MAX_CHIP_BATCH_SIZE
+        batch_size = MAX_CHIP_BATCH_SIZE
+
+    set_seed(seed)
+    model_config = EncoderConfig.tiny() if args.cpu or args.tiny else EncoderConfig.bert_base()
+    train_dataloader, eval_dataloader = get_dataloaders(
+        accelerator, batch_size, model_config,
+        train_len=config.get("train_len", 512), eval_len=config.get("eval_len", 128),
+    )
+
+    model_def = EncoderClassifier(model_config, mesh=accelerator.mesh)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(seed), batch_size=batch_size, seq_len=min(model_config.max_seq_len, 128)
+    )
+
+    # New Code #
+    # no warmup_cosine_decay_schedule, no total-steps arithmetic: the
+    # schedule-free wrapper replaces the entire LR schedule
+    optimizer_def = optax.contrib.schedule_free(
+        optax.adamw(lr), learning_rate=lr, b1=0.9
+    )
+    model, optimizer, train_dataloader, eval_dataloader = accelerator.prepare(
+        Model(model_def, variables), optimizer_def, train_dataloader, eval_dataloader
+    )
+    # End New Code #
+
+    for epoch in range(num_epochs):
+        model.train()
+        for step, batch in enumerate(train_dataloader):
+            outputs = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                labels=batch["labels"],
+                deterministic=False,
+            )
+            loss = outputs["loss"]
+            accelerator.backward(loss)
+            if step % gradient_accumulation_steps == 0:
+                # New Code #
+                # no lr_scheduler.step(): schedule-free has no schedule
+                optimizer.step()
+                optimizer.zero_grad()
+                # End New Code #
+
+        model.eval()
+        # New Code #
+        # evaluate on the schedule-free AVERAGED params (x), not the fast
+        # iterate (y/z) the optimizer trains on
+        train_params = model._engine.params
+        model._engine.params = optax.contrib.schedule_free_eval_params(
+            model._engine.opt_state, train_params
+        )
+        # End New Code #
+        correct = total = 0
+        for step, batch in enumerate(eval_dataloader):
+            outputs = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+            )
+            predictions = outputs["logits"].argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics((predictions, batch["labels"]))
+            correct += int((np.asarray(predictions) == np.asarray(references)).sum())
+            total += int(np.asarray(references).shape[0])
+        accelerator.print(f"epoch {epoch}: {{'accuracy': {correct / max(total, 1):.4f}}}")
+        # New Code #
+        model._engine.params = train_params  # restore the fast iterate
+        # End New Code #
+
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Schedule-free optimizer example.")
+    parser.add_argument(
+        "--mixed_precision",
+        type=str,
+        default=None,
+        choices=["no", "fp16", "bf16"],
+        help="Whether to use mixed precision (bf16 is the TPU-native choice).",
+    )
+    parser.add_argument("--cpu", action="store_true", help="Run the tiny config on CPU.")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model/dataset (CI).")
+    parser.add_argument("--num_epochs", type=int, default=None)
+    args = parser.parse_args()
+    # New Code #
+    # schedule-free runs hotter than scheduled AdamW; 1e-3-ish works where
+    # a cosine schedule would have peaked around the same value
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs or 3, "seed": 42, "batch_size": 16}
+    # End New Code #
+    if args.tiny or args.cpu:
+        config.update({"train_len": 128, "eval_len": 64})
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

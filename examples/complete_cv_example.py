@@ -1,0 +1,149 @@
+"""Complete CV example: the base cv_example plus checkpointing, mid-epoch
+resume, LR scheduling, and experiment tracking.
+
+Mirrors the user-API shape of the reference
+(/root/reference/examples/complete_cv_example.py:110-280): --with_tracking
+enables init_trackers/log/end_training, --checkpointing_steps {N,"epoch"}
+drives save_state into project_dir, --resume_from_checkpoint restores state
+(including BatchNorm running statistics, which travel as extra mutable
+state through the checkpoint) and skips already-seen batches via
+skip_first_batches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, DataLoader, Model
+from accelerate_tpu.data import skip_first_batches
+from accelerate_tpu.models import ResNet, VisionConfig
+from accelerate_tpu.utils.random import set_seed
+
+import sys
+
+sys.path.append(os.path.dirname(os.path.abspath(__file__)))
+from cv_example import PrototypeImageDataset  # noqa: E402
+
+
+def training_function(config, args):
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with="jsonl" if args.with_tracking else None,
+        project_dir=args.project_dir,
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_cv_example", config)
+
+    lr, num_epochs, seed, batch_size = (
+        config["lr"], int(config["num_epochs"]), int(config["seed"]), int(config["batch_size"])
+    )
+    image_size = int(config["image_size"])
+    set_seed(seed)
+    model_config = (
+        VisionConfig.tiny(image_size=image_size)
+        if (args.cpu or args.tiny)
+        else VisionConfig.resnet50(num_classes=config["num_classes"], image_size=image_size)
+    )
+
+    train_ds = PrototypeImageDataset(config["train_len"], image_size, config["num_classes"], seed=seed)
+    eval_ds = PrototypeImageDataset(config["eval_len"], image_size, config["num_classes"], seed=seed + 1)
+    train_dataloader = DataLoader(train_ds, batch_size=batch_size, shuffle=True, drop_last=True)
+    eval_dataloader = DataLoader(eval_ds, batch_size=batch_size, shuffle=False)
+
+    model_def = ResNet(model_config)
+    variables = model_def.init_variables(jax.random.PRNGKey(seed), batch_size=batch_size, image_size=image_size)
+    total_steps = len(train_dataloader) * num_epochs
+    lr_schedule = optax.cosine_decay_schedule(lr, max(total_steps, 1))
+    model, optimizer, train_dataloader, eval_dataloader, lr_scheduler = accelerator.prepare(
+        Model(model_def, variables),
+        optax.sgd(lr_schedule, momentum=0.9),
+        train_dataloader,
+        eval_dataloader,
+        lr_schedule,
+    )
+
+    overall_step = 0
+    starting_epoch = 0
+    resume_step = None
+    if args.resume_from_checkpoint:
+        accelerator.print(f"Resuming from checkpoint: {args.resume_from_checkpoint}")
+        accelerator.load_state(args.resume_from_checkpoint)
+        path = os.path.basename(args.resume_from_checkpoint.rstrip("/"))
+        if "epoch" in path:
+            starting_epoch = int(path.replace("epoch_", "")) + 1
+        else:
+            resume_step = int(path.replace("step_", ""))
+            starting_epoch = resume_step // len(train_dataloader)
+            resume_step -= starting_epoch * len(train_dataloader)
+            overall_step = resume_step + starting_epoch * len(train_dataloader)
+
+    for epoch in range(starting_epoch, num_epochs):
+        model.train()
+        total_loss = 0.0
+        if args.resume_from_checkpoint and epoch == starting_epoch and resume_step is not None:
+            active_dataloader = skip_first_batches(train_dataloader, resume_step)
+        else:
+            active_dataloader = train_dataloader
+        for batch in active_dataloader:
+            outputs = model(batch["image"], labels=batch["label"], train=True)
+            total_loss += float(jax.device_get(outputs["loss"]))
+            accelerator.backward(outputs["loss"])
+            optimizer.step()
+            lr_scheduler.step()
+            optimizer.zero_grad()
+            overall_step += 1
+
+            if isinstance(args.checkpointing_steps, int) and overall_step % args.checkpointing_steps == 0:
+                accelerator.save_state(os.path.join(args.project_dir or ".", f"step_{overall_step}"))
+
+        model.eval()
+        correct = total = 0
+        for batch in eval_dataloader:
+            outputs = model(batch["image"])
+            predictions = outputs["logits"].argmax(axis=-1)
+            predictions, references = accelerator.gather_for_metrics((predictions, batch["label"]))
+            correct += int((np.asarray(predictions) == np.asarray(references)).sum())
+            total += int(np.asarray(references).shape[0])
+        accuracy = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: accuracy = {100 * accuracy:.2f}%")
+        if args.with_tracking:
+            accelerator.log(
+                {"accuracy": accuracy, "train_loss": total_loss / max(len(train_dataloader), 1)},
+                step=epoch,
+            )
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(os.path.join(args.project_dir or ".", f"epoch_{epoch}"))
+
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Complete CV training script example.")
+    parser.add_argument("--mixed_precision", type=str, default=None, choices=["no", "fp16", "bf16"])
+    parser.add_argument("--cpu", action="store_true", help="Run the tiny config on CPU.")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model/dataset (CI).")
+    parser.add_argument("--num_epochs", type=int, default=None)
+    parser.add_argument(
+        "--checkpointing_steps", type=str, default=None,
+        help="Save state every N steps (int) or 'epoch'.",
+    )
+    parser.add_argument("--resume_from_checkpoint", type=str, default=None)
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--project_dir", type=str, default=None)
+    args = parser.parse_args()
+    if args.checkpointing_steps is not None and args.checkpointing_steps != "epoch":
+        args.checkpointing_steps = int(args.checkpointing_steps)
+    config = {"lr": 0.02, "num_epochs": args.num_epochs or 3, "seed": 42, "batch_size": 16,
+              "image_size": 224, "num_classes": 37, "train_len": 512, "eval_len": 128}
+    if args.tiny or args.cpu:
+        config.update({"image_size": 32, "num_classes": 8, "train_len": 128, "eval_len": 64, "batch_size": 8})
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

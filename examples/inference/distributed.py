@@ -1,0 +1,77 @@
+"""Inference example: distributed batch generation with
+`split_between_processes`.
+
+Mirrors the reference's examples/inference/distributed pattern
+(/root/reference/examples/inference/distributed/phi2.py): a pool of prompts
+is split across processes — each process generates continuations for its
+share on its own chips, then the results are gathered back in order. This
+is throughput-oriented offline inference (every process holds a full model
+replica); see pippy.py for the model-bigger-than-one-chip case.
+
+Run: accelerate-tpu launch --num_processes 2 --cpu examples/inference/distributed.py --tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models import DecoderConfig, DecoderLM
+from accelerate_tpu.parallel.sharding import unbox_params
+from accelerate_tpu.utils.operations import gather_object
+from accelerate_tpu.utils.random import set_seed
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Distributed generation example.")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--tiny", action="store_true", help="Tiny model (CI).")
+    parser.add_argument("--max_new_tokens", type=int, default=16)
+    parser.add_argument("--num_prompts", type=int, default=8)
+    parser.add_argument("--prompt_len", type=int, default=16)
+    args = parser.parse_args()
+
+    accelerator = Accelerator()
+    set_seed(0)
+
+    cfg = DecoderConfig.tiny() if (args.cpu or args.tiny) else DecoderConfig.small_1b()
+    model_def = DecoderLM(cfg)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(0), batch_size=1, seq_len=args.prompt_len
+    )
+    params, _ = unbox_params(variables["params"])
+    params = jax.device_put(jax.tree_util.tree_map(lambda x: x.astype(cfg.dtype), params))
+
+    # the prompt pool: identical on every process (seeded), split by rank
+    rng = np.random.RandomState(7)
+    prompts = [
+        rng.randint(3, cfg.vocab_size, (args.prompt_len,)).tolist()
+        for _ in range(args.num_prompts)
+    ]
+
+    completions = []
+    with accelerator.split_between_processes(prompts) as my_prompts:
+        accelerator.print(
+            f"{accelerator.num_processes} process(es), "
+            f"{len(my_prompts)} prompt(s) on rank {accelerator.process_index}"
+        )
+        for prompt in my_prompts:
+            ids = np.asarray([prompt], np.int32)
+            out = generate(model_def, params, ids, max_new_tokens=args.max_new_tokens)
+            completions.append(np.asarray(out)[0, len(prompt):].tolist())
+
+    # gather preserves rank order, so completions line up with the pool
+    everyone = gather_object(completions)
+    assert len(everyone) == len(prompts), (len(everyone), len(prompts))
+    if accelerator.is_main_process:
+        for i, (prompt, completion) in enumerate(zip(prompts, everyone)):
+            print(f"prompt {i}: ...{prompt[-4:]} -> {completion[:8]}...")
+    accelerator.print("distributed generation done")
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,43 @@ class TestEstimateCommand:
         data = json.loads(r.stdout.strip().splitlines()[-1])
         assert data["rows"][0]["inference_total"] == 700_000_000
 
+    def test_arbitrary_checkpoint_header_only(self, tmp_path):
+        """estimate reads ANY safetensors checkpoint's header — shapes and
+        dtypes only, hand-checkable sizes (reference estimate.py:63 meta-load
+        + :215 training table)."""
+        import ml_dtypes
+
+        from accelerate_tpu.utils.serialization import save_pytree
+
+        tree = {
+            "embed/table": np.zeros((100, 32), ml_dtypes.bfloat16),  # 3200 params
+            "layer/w": np.zeros((32, 48), np.float32),               # 1536 params
+            "layer/b": np.zeros((48,), np.float32),                  # 48 params
+        }
+        ckpt = tmp_path / "model.safetensors"
+        save_pytree(tree, ckpt)
+        r = _run(["estimate", str(ckpt), "--dtypes", "bfloat16", "float32", "--json"])
+        assert r.returncode == 0, r.stderr
+        data = json.loads(r.stdout.strip().splitlines()[-1])
+        n = 3200 + 1536 + 48
+        row_bf16 = next(row for row in data["rows"] if row["dtype"] == "bfloat16")
+        row_f32 = next(row for row in data["rows"] if row["dtype"] == "float32")
+        assert row_bf16["params"] == n
+        assert row_bf16["inference_total"] == 2 * n
+        # train = params + grads (dtype) + Adam m/v fp32 + fp32 master copy
+        assert row_bf16["training_total_adam"] == 2 * n + 2 * n + 8 * n + 4 * n
+        assert row_f32["training_total_adam"] == 4 * n + 4 * n + 8 * n
+        assert data["checkpoint_dtypes"] == {"bfloat16": 6400, "float32": 4 * 1584}
+        # "embed" stores 3200 bf16 params = 6400 B > "layer" 1584*4 = 6336 B
+        assert data["largest_group_bytes"] == 6400
+        # sharded-index checkpoints inspect header-only too
+        sharded = tmp_path / "sh" / "model.safetensors"
+        save_pytree(tree, sharded, max_shard_size=6000)
+        r = _run(["estimate", str(sharded), "--dtypes", "bfloat16", "--json"])
+        assert r.returncode == 0, r.stderr
+        data2 = json.loads(r.stdout.strip().splitlines()[-1])
+        assert data2["rows"][0]["params"] == n
+
 
 class TestMergeCommand:
     def test_merge_roundtrip(self, tmp_path):

@@ -34,6 +34,18 @@ def _run_example(rel_path, *extra, timeout=420):
     )
 
 
+def _run_inference_example(rel_path, *extra, timeout=420):
+    """Inference examples take --cpu/--tiny but no training args."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, rel_path), "--cpu", "--tiny", *extra],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
 @pytest.mark.slow
 class TestExamplesRun:
     def test_nlp_example(self):
@@ -82,6 +94,65 @@ class TestExamplesRun:
         assert r.returncode == 0, r.stderr
         assert "accuracy" in r.stdout
 
+    def test_early_stopping_example(self):
+        r = _run_example(os.path.join("by_feature", "early_stopping.py"),
+                         "--num_epochs", "4", "--patience", "1")
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout
+
+    def test_profiler_example(self, tmp_path):
+        r = _run_example(os.path.join("by_feature", "profiler.py"),
+                         "--trace_dir", str(tmp_path / "traces"))
+        assert r.returncode == 0, r.stderr
+        assert "trace written" in r.stdout
+
+    def test_multi_process_metrics_example(self):
+        r = _run_example(os.path.join("by_feature", "multi_process_metrics.py"))
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout and "examples" in r.stdout
+
+    def test_automatic_gradient_accumulation_example(self):
+        r = _run_example(os.path.join("by_feature", "automatic_gradient_accumulation.py"))
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout
+
+    def test_schedule_free_example(self):
+        r = _run_example(os.path.join("by_feature", "schedule_free.py"))
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout
+
+    def test_cross_validation_example(self):
+        r = _run_example(os.path.join("by_feature", "cross_validation.py"),
+                         "--num_folds", "2", "--num_epochs", "1")
+        assert r.returncode == 0, r.stderr
+        assert "ensemble test accuracy" in r.stdout
+
+    def test_complete_cv_example(self, tmp_path):
+        r = _run_example(
+            "complete_cv_example.py",
+            "--checkpointing_steps", "epoch",
+            "--with_tracking",
+            "--project_dir", str(tmp_path),
+        )
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "epoch_0").exists(), list(tmp_path.iterdir())
+        r2 = _run_example(
+            "complete_cv_example.py",
+            "--project_dir", str(tmp_path),
+            "--resume_from_checkpoint", str(tmp_path / "epoch_0"),
+        )
+        assert r2.returncode == 0, r2.stderr
+
+    def test_inference_distributed_example(self):
+        r = _run_inference_example(os.path.join("inference", "distributed.py"))
+        assert r.returncode == 0, r.stderr
+        assert "distributed generation done" in r.stdout
+
+    def test_inference_pippy_example(self):
+        r = _run_inference_example(os.path.join("inference", "pippy.py"))
+        assert r.returncode == 0, r.stderr
+        assert "pipelined forward OK" in r.stdout
+
     def test_complete_example_checkpoints_and_resumes(self, tmp_path):
         r = _run_example(
             "complete_nlp_example.py",
@@ -98,6 +169,40 @@ class TestExamplesRun:
             "--resume_from_checkpoint", str(tmp_path / "epoch_0"),
         )
         assert r2.returncode == 0, r2.stderr
+
+
+class TestCanonDiff:
+    """The canon-diff machinery (reference test_utils/examples.py +
+    tests/test_examples.py:290): every fenced by_feature script must be the
+    canonical example plus `# New Code #` fenced additions, and must keep
+    the bulk of the canon's training loop."""
+
+    CANON = os.path.join(EXAMPLES, "nlp_example.py")
+    FENCED = (
+        "by_feature/early_stopping.py",
+        "by_feature/profiler.py",
+        "by_feature/multi_process_metrics.py",
+        "by_feature/automatic_gradient_accumulation.py",
+        "by_feature/schedule_free.py",
+        "by_feature/cross_validation.py",
+    )
+
+    @pytest.mark.parametrize("rel", FENCED)
+    def test_additions_are_fenced(self, rel):
+        from accelerate_tpu.test_utils.examples import fence_violations
+
+        bad = fence_violations(self.CANON, os.path.join(EXAMPLES, rel))
+        assert not bad, (
+            f"{rel}: lines added outside '# New Code #' fences:\n"
+            + "\n".join(f"  {n}: {l}" for n, l in bad[:10])
+        )
+
+    @pytest.mark.parametrize("rel", FENCED)
+    def test_canon_loop_survives(self, rel):
+        from accelerate_tpu.test_utils.examples import canon_coverage
+
+        cov = canon_coverage(self.CANON, os.path.join(EXAMPLES, rel))
+        assert cov >= 0.55, f"{rel}: only {cov:.0%} of the canon remains — a rewrite, not a feature diff"
 
 
 class TestExamplesDiff:

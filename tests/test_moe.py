@@ -93,10 +93,15 @@ class TestMoeDecoder:
         from accelerate_tpu.parallel.sharding import unbox_params
 
         raw, _ = unbox_params(variables["params"])
-        out = model.apply({"params": raw}, ids, labels=ids)
+
+        # one compile: forward outputs ride along as grad aux
+        def loss_and_out(p):
+            o = model.apply({"params": p}, ids, labels=ids)
+            return o["loss"], o
+
+        grads, out = jax.grad(loss_and_out, has_aux=True)(raw)
         assert {"loss", "lm_loss", "aux_loss"} <= set(out)
         assert np.isfinite(float(out["loss"]))
-        grads = jax.grad(lambda p: model.apply({"params": p}, ids, labels=ids)["loss"])(raw)
         flat_leaves = jax.tree_util.tree_leaves(grads)
         assert all(np.isfinite(np.asarray(g)).all() for g in flat_leaves)
         # router grads must be nonzero (aux loss reaches the router)

@@ -75,7 +75,10 @@ class TestPipelineParity:
         mapped = _dense_to_pipelined(dense_raw, pipe_raw, num_stages)
         return dense, pipe, dense_raw, mapped
 
-    @pytest.mark.parametrize("num_stages,num_micro", [(2, 2), (2, 4), (4, 4)])
+    @pytest.mark.parametrize(
+        "num_stages,num_micro",
+        [(2, 2), pytest.param(4, 4, marks=pytest.mark.slow)],
+    )
     def test_forward_parity(self, num_stages, num_micro):
         dense, pipe, dense_p, pipe_p = self._models_and_params(num_stages, num_micro)
         ids = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 256)
@@ -84,6 +87,8 @@ class TestPipelineParity:
         np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_p), rtol=2e-5, atol=2e-5)
 
     def test_loss_and_grad_parity(self):
+        # doubles as the (2, 4) forward-parity combo: loss parity implies
+        # forward parity through the fused-CE head, one model build total
         dense, pipe, dense_p, pipe_p = self._models_and_params(2, 4)
         ids = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 256)
 
