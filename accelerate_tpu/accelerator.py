@@ -627,6 +627,12 @@ class TrainEngine:
         """One jit: split batch into micro-batches, lax.scan fwd+bwd
         accumulating grads, clip, update. Returns step(batch)->metrics."""
         micro = micro_steps or self.gradient_state.num_steps
+        if (
+            getattr(self.sharding_config, "grad_compression_dtype", None)
+            and self.mesh is not None
+            and self.mesh.shape.get("replica", 1) > 1
+        ):
+            return self._build_compressed_replica_step(loss_fn, micro)
         user_loss = loss_fn
         max_norm = self._clip_max_norm
 
@@ -711,6 +717,140 @@ class TrainEngine:
             return out
 
         return apply_fn
+
+    def _build_compressed_replica_step(self, loss_fn, micro):
+        """Train step with a COMPRESSED cross-slice gradient all-reduce — the
+        TPU analog of the reference's DDP comm hooks (fp16/bf16 compression
+        on the gradient bucket all-reduce, reference utils/dataclasses.py:
+        111-208). The step runs under an explicit shard_map over the mesh so
+        the two reduction hops are separate collectives:
+
+          1. fp32 mean over the intra-slice data axes — rides ICI, cheap;
+          2. mean over the "replica" axis in ``grad_compression_dtype`` —
+             this is the DCN-crossing hop on a multi-slice HYBRID mesh,
+             where halving (bf16/fp16) or quartering (int8) the bytes
+             directly cuts step time.
+
+        int8 uses a cross-replica-consistent per-tensor scale with headroom
+        so the on-wire psum cannot overflow (max |q| <= 127/num_replicas).
+        Scope matches the reference's hooks (DDP): params replicated,
+        replica x data mesh; FSDP/TP meshes raise in ShardingConfig."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        comp_name = self.sharding_config.grad_compression_dtype
+        optimizer = self.optimizer
+        user_loss = loss_fn
+        if self.scale_state is not None:
+            raise ValueError(
+                "grad compression + fp16 loss scaling are not composed yet; "
+                "use bf16 mixed precision with compressed gradients"
+            )
+        n_replica = mesh.shape["replica"]
+        data_axes = tuple(
+            a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1
+        )
+        batch_axes = ("replica",) + data_axes
+
+        def _compress_mean(g):
+            g = g.astype(jnp.float32)
+            if data_axes:
+                g = jax.lax.pmean(g, data_axes)
+            if comp_name == "int8":
+                cap = 127 // n_replica  # sum over R replicas stays <= 127
+                absmax = jax.lax.pmax(jnp.max(jnp.abs(g)), "replica")
+                scale = absmax / cap + 1e-30
+                q = jnp.clip(jnp.round(g / scale), -cap, cap).astype(jnp.int8)
+                summed = jax.lax.psum(q, "replica")  # int8 on the wire
+                return summed.astype(jnp.float32) * scale / n_replica
+            comp = jnp.dtype(comp_name)
+            return jax.lax.pmean(g.astype(comp), "replica").astype(jnp.float32)
+
+        def body(params, opt_state, extra_state, rng_key, batch):
+            idx = jax.lax.axis_index(batch_axes)
+            base_key = jax.random.fold_in(rng_key, idx)
+
+            def one_micro(carry, mb):
+                acc, loss_acc, key, es = carry
+                key, sub = jax.random.split(key)
+
+                def local_loss(p):
+                    # same loss_fn contract as the normal path: a user-
+                    # supplied fn receives (apply_fn, params, batch)
+                    if user_loss is not None:
+                        return (
+                            user_loss(self._make_apply(es, sub), p, mb).astype(jnp.float32),
+                            es,
+                        )
+                    args, kwargs = _batch_to_call(mb)
+                    outputs, new_es = self._apply(
+                        self._cast_params(p), es, True, sub, args, kwargs
+                    )
+                    return self.loss_fn(outputs).astype(jnp.float32), new_es
+
+                (l, new_es), g = jax.value_and_grad(local_loss, has_aux=True)(params)
+                acc = jax.tree_util.tree_map(
+                    lambda a, x: a + x.astype(jnp.float32) / micro, acc, g
+                )
+                return (acc, loss_acc + l / micro, key, new_es), None
+
+            zero = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            carry0 = (zero, jnp.asarray(0.0), base_key, extra_state)
+            if micro > 1:
+                mbs = jax.tree_util.tree_map(
+                    lambda x: x.reshape((micro, x.shape[0] // micro) + x.shape[1:]), batch
+                )
+                (grads, loss, _, new_es), _ = jax.lax.scan(one_micro, carry0, mbs)
+            else:
+                (grads, loss, _, new_es), _ = one_micro(carry0, batch)
+
+            grads = jax.tree_util.tree_map(_compress_mean, grads)
+            loss = jax.lax.pmean(loss, batch_axes)
+            # mutable collections (e.g. BatchNorm stats) were updated from
+            # each shard's local batch: average float leaves so every shard
+            # leaves with the same, global-batch-equivalent statistics
+            new_es = jax.tree_util.tree_map(
+                lambda x: jax.lax.pmean(x, batch_axes)
+                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                else x,
+                new_es,
+            )
+            grad_norm = optax.global_norm(grads)  # pre-clip, like the normal path
+            if self._clip_max_norm is not None:
+                factor = jnp.minimum(1.0, self._clip_max_norm / (grad_norm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), params, updates
+            )
+            metrics = {"loss": loss, "grad_norm": grad_norm}
+            return new_params, new_opt, new_es, metrics
+
+        rep = P()
+        stepped = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(rep, rep, rep, rep, P(batch_axes)),
+            out_specs=(rep, rep, rep, rep),
+            axis_names=set(mesh.axis_names),
+            check_vma=False,
+        )
+        jitted = jax.jit(stepped, donate_argnums=(0, 1) if self.donate_state else ())
+
+        def run(batch):
+            rng_key = default_keychain().next_key("train_step")
+            new_params, new_opt, new_es, metrics = jitted(
+                self.params, self.opt_state, self.extra_state, rng_key, batch
+            )
+            self.params, self.opt_state = new_params, new_opt
+            self.extra_state = new_es
+            self.step_count += 1
+            return metrics
+
+        return run
 
 
 def _enable_fp8(definition):

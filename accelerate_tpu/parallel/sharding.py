@@ -199,6 +199,13 @@ def constrain_activation(x, logical_names: tuple, mesh: Optional[Mesh], rules=No
         return x
     rules = rules or DEFAULT_AXIS_RULES
     spec = logical_to_spec(logical_names, rules, mesh)
+    # under a shard_map (e.g. the compressed-replica train step or LocalSGD),
+    # manual axes must not appear in sharding constraints — the body already
+    # IS per-shard on those axes
+    try:
+        manual = set(jax.sharding.get_abstract_mesh().manual_axes)
+    except Exception:  # pragma: no cover - older tracing contexts
+        manual = set()
     parts = []
     for i, dim in enumerate(x.shape):
         entry = spec[i] if i < len(spec) else None
@@ -208,11 +215,20 @@ def constrain_activation(x, logical_names: tuple, mesh: Optional[Mesh], rules=No
         axes = entry if isinstance(entry, tuple) else (entry,)
         kept, prod = [], 1
         for ax in axes:
+            if ax in manual:
+                continue
             n = mesh.shape[ax]
             if dim % (prod * n) == 0:
                 kept.append(ax)
                 prod *= n
         parts.append(tuple(kept) if len(kept) > 1 else (kept[0] if kept else None))
+    if all(p is None for p in parts):
+        return x
+    if manual:
+        # inside the manual region only the non-manual sub-mesh is visible
+        from jax.sharding import AbstractMesh  # noqa: F401  (doc pointer)
+
+        return jax.lax.with_sharding_constraint(x, P(*parts))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*parts)))
 
 

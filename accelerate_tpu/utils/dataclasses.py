@@ -300,6 +300,14 @@ class ShardingConfig:
     pipeline_parallel: int = 1
     replica: int = 1
     axis_rules: Optional[tuple] = None
+    # Gradient compression for the cross-slice (DCN) all-reduce — the TPU
+    # analog of the reference's DDP comm hooks (utils/dataclasses.py:111-208
+    # fp16/bf16/powerSGD): grads mean in fp32 over the intra-slice ICI axes,
+    # then cross "replica" in this dtype ("bfloat16" | "float16" | "int8").
+    # Like the reference's hooks (DDP-only), this applies to replicated-
+    # param meshes (replica x data); FSDP/TP shards reduce over ICI where
+    # compression buys nothing.
+    grad_compression_dtype: Optional[str] = None
     # FSDP-detail parity knobs
     min_weight_size_to_shard: int = 2**18  # don't shard tiny params (biases, norms)
     offload_params_to_host: bool = False   # ≙ FSDP cpu_offload: params live in pinned_host, stream per step
@@ -316,6 +324,30 @@ class ShardingConfig:
             raise ValueError("mesh axis degrees must be >= 1 (or -1 for 'rest')")
         if sum(1 for d in degrees.values() if d == -1) > 1:
             raise ValueError("at most one mesh axis may be -1")
+        if self.grad_compression_dtype is not None:
+            if self.grad_compression_dtype not in ("bfloat16", "float16", "int8"):
+                raise ValueError(
+                    f"grad_compression_dtype must be bfloat16/float16/int8, "
+                    f"got {self.grad_compression_dtype!r}"
+                )
+            sharded = {
+                "fsdp": self.fsdp, "tensor_parallel": self.tensor_parallel,
+                "sequence_parallel": self.sequence_parallel,
+                "expert_parallel": self.expert_parallel,
+                "pipeline_parallel": self.pipeline_parallel,
+            }
+            bad = {k: v for k, v in sharded.items() if v not in (1, None)}
+            if bad:
+                raise ValueError(
+                    "grad_compression_dtype applies to replicated-param "
+                    f"(replica x data) meshes, like the reference's DDP comm "
+                    f"hooks; incompatible axes: {bad}"
+                )
+            if self.offload_params_to_host or self.offload_optimizer_state:
+                raise ValueError(
+                    "grad_compression_dtype is not composed with host "
+                    "offload yet (the compressed step keeps state in HBM)"
+                )
 
     def axis_degrees(self) -> dict:
         return {

@@ -535,3 +535,40 @@ class TestTrackers:
         assert lines[0]["event"] == "config"
         assert lines[1]["values"]["loss"] == 1.5
         assert lines[2]["step"] == 1
+
+
+class TestGradCompression:
+    """Compressed cross-replica gradient all-reduce (the DDP comm-hook
+    analog, ShardingConfig.grad_compression_dtype) on a replica=2 mesh."""
+
+    def _train(self, compress, steps=10):
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state()
+        sc = ShardingConfig(replica=2, data_parallel=4, grad_compression_dtype=compress)
+        accelerator = Accelerator(sharding_config=sc)
+        model, _ = accelerator.prepare(make_regression_model(), optax.sgd(0.05))
+        step = accelerator.build_train_step()
+        xs = np.linspace(-1, 1, 32, dtype=np.float32).reshape(-1, 1)
+        ys = (2.5 * xs + 1.0).astype(np.float32)
+        batch = accelerator.prepare_for_eval({"x": xs, "y": ys})
+        losses = [float(jax.device_get(step(batch)["loss"])) for _ in range(steps)]
+        return {k: np.asarray(v) for k, v in model.params.items()}, losses
+
+    @pytest.mark.parametrize("compress,tol", [("bfloat16", 1e-2), ("int8", 5e-2)])
+    def test_matches_uncompressed_within_tolerance(self, compress, tol):
+        p_u, l_u = self._train(None)
+        assert l_u[-1] < l_u[0]
+        p_c, l_c = self._train(compress)
+        assert l_c[-1] < l_c[0]
+        for key in p_u:
+            np.testing.assert_allclose(p_c[key], p_u[key], atol=tol)
+
+    def test_rejects_sharded_param_meshes(self):
+        with pytest.raises(ValueError, match="replicated-param"):
+            ShardingConfig(replica=2, fsdp=2, grad_compression_dtype="bfloat16")
+
+    def test_rejects_unknown_dtype(self):
+        with pytest.raises(ValueError, match="bfloat16/float16/int8"):
+            ShardingConfig(replica=2, grad_compression_dtype="fp4")
