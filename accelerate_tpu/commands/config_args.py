@@ -42,6 +42,9 @@ class ClusterConfig:
     expert_parallel: int = 1
     pipeline_parallel: int = 1
     replica: int = 1
+    # cross-slice gradient all-reduce dtype: bfloat16/float16/int8
+    # (bf16/fp16 aliases accepted); validated by ShardingConfig
+    grad_compression_dtype: Optional[str] = None
     # pod fan-out
     tpu_name: Optional[str] = None
     tpu_zone: Optional[str] = None
@@ -77,6 +80,11 @@ class ClusterConfig:
             data = yaml.safe_load(raw)
         except ImportError:
             data = json.loads(raw)
+        # renamed-key migrations: old spellings carry their value forward
+        renames = {"num_machines": "num_processes"}
+        for old, new in renames.items():
+            if old in data and new not in data:
+                data[new] = data.pop(old)
         known = {f.name for f in dataclasses.fields(cls)}
         extra = {k: v for k, v in data.items() if k not in known}
         if extra:

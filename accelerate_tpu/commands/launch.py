@@ -47,6 +47,9 @@ def register(subparsers):
                  "expert_parallel", "pipeline_parallel", "replica"):
         parser.add_argument(f"--{axis}", type=int, default=None)
     parser.add_argument("--sharding_strategy", default=None)
+    parser.add_argument("--grad_compression_dtype", default=None,
+                        choices=["bfloat16", "float16", "int8", "bf16", "fp16", "none"],
+                        help="Compress the cross-slice (DCN) gradient all-reduce; 'none' disables")
     # pod fan-out
     parser.add_argument("--tpu_name", default=None)
     parser.add_argument("--tpu_zone", default=None)
@@ -93,6 +96,10 @@ def _merge(args, config: ClusterConfig) -> ClusterConfig:
         v = getattr(args, axis)
         if v is not None:
             setattr(merged, axis, v)
+    if args.grad_compression_dtype is not None:
+        merged.grad_compression_dtype = (
+            None if args.grad_compression_dtype == "none" else args.grad_compression_dtype
+        )
     for flag in ("tpu_name", "tpu_zone", "tpu_project"):
         v = getattr(args, flag)
         if v is not None:
@@ -120,6 +127,9 @@ def prepare_launch_env(config: ClusterConfig, args=None) -> dict:
         ("replica", "REPLICA"),
     ):
         env[env_var(name)] = str(getattr(config, axis))
+    # always stomp (like the axis vars): a stale inherited value must not
+    # resurrect compression the current config doesn't ask for
+    env[env_var("GRAD_COMPRESSION")] = config.grad_compression_dtype or ""
     if config.debug:
         env[env_var("DEBUG_MODE")] = "1"
     if config.downcast_bf16:

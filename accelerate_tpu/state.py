@@ -378,10 +378,12 @@ def _sharding_config_from_env() -> ShardingConfig:
         "EXPERT_PARALLEL": ("expert_parallel", int),
         "PIPELINE_PARALLEL": ("pipeline_parallel", int),
         "REPLICA": ("replica", int),
+        "GRAD_COMPRESSION": ("grad_compression_dtype", str),
     }
     for env_name, (field_name, cast) in mapping.items():
         v = get_env(env_name)
-        if v is not None:
+        if v:  # unset AND empty both mean "not configured" (launcher stomps
+            #    GRAD_COMPRESSION with "" to kill stale inherited values)
             kwargs[field_name] = cast(v)
     return ShardingConfig(**kwargs)
 

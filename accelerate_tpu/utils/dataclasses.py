@@ -325,10 +325,14 @@ class ShardingConfig:
         if sum(1 for d in degrees.values() if d == -1) > 1:
             raise ValueError("at most one mesh axis may be -1")
         if self.grad_compression_dtype is not None:
+            aliases = {"bf16": "bfloat16", "fp16": "float16"}
+            self.grad_compression_dtype = aliases.get(
+                self.grad_compression_dtype, self.grad_compression_dtype
+            )
             if self.grad_compression_dtype not in ("bfloat16", "float16", "int8"):
                 raise ValueError(
-                    f"grad_compression_dtype must be bfloat16/float16/int8, "
-                    f"got {self.grad_compression_dtype!r}"
+                    f"grad_compression_dtype must be bfloat16/float16/int8 "
+                    f"(or the bf16/fp16 aliases), got {self.grad_compression_dtype!r}"
                 )
             sharded = {
                 "fsdp": self.fsdp, "tensor_parallel": self.tensor_parallel,

@@ -104,6 +104,79 @@ class TestEstimateCommand:
         assert data2["rows"][0]["params"] == n
 
 
+class TestConfigMigration:
+    """Version-migration round-trips (reference tests/test_cli.py:519 with
+    tests/test_configs/0_11_0.yaml..latest.yaml): older or foreign config
+    files load, launch-env building works, and `config update` rewrites them
+    to the current schema — new fields added with defaults, stale keys
+    dropped."""
+
+    FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "test_configs")
+
+    @pytest.mark.parametrize("fixture", ["r1_schema.yaml", "foreign_keys.yaml", "latest.yaml"])
+    def test_loads_and_builds_launch_env(self, fixture):
+        from accelerate_tpu.commands.config_args import load_config_from_file
+        from accelerate_tpu.commands.launch import prepare_launch_env
+
+        cfg = load_config_from_file(os.path.join(self.FIXTURES, fixture))
+        env = prepare_launch_env(cfg)
+        assert env["ACCELERATE_TPU_MIXED_PRECISION"] == cfg.mixed_precision
+        assert "ACCELERATE_TPU_REPLICA" in env
+
+    def test_shared_keys_honored_foreign_dropped(self):
+        from accelerate_tpu.commands.config_args import load_config_from_file
+
+        cfg = load_config_from_file(os.path.join(self.FIXTURES, "foreign_keys.yaml"))
+        assert cfg.mixed_precision == "fp16"
+        assert cfg.num_processes == 4
+        assert cfg.downcast_bf16 is True
+        assert not hasattr(cfg, "dynamo_backend")
+        assert not hasattr(cfg, "fsdp_config")
+
+    def test_renamed_key_carries_value(self):
+        from accelerate_tpu.commands.config_args import load_config_from_file
+
+        cfg = load_config_from_file(os.path.join(self.FIXTURES, "r1_schema.yaml"))
+        # num_machines -> num_processes rename must not lose the host count
+        assert cfg.num_processes == 2
+
+    @pytest.mark.parametrize("fixture", ["r1_schema.yaml", "foreign_keys.yaml"])
+    def test_update_migrates_to_current_schema(self, fixture, tmp_path):
+        import shutil
+
+        import dataclasses
+
+        from accelerate_tpu.commands.config_args import ClusterConfig
+
+        path = tmp_path / "config.yaml"
+        shutil.copy(os.path.join(self.FIXTURES, fixture), path)
+        r = _run(["config", "update", "--config_file", str(path)])
+        assert r.returncode == 0, r.stderr
+        import yaml
+
+        data = yaml.safe_load(open(path))
+        current = {f.name for f in dataclasses.fields(ClusterConfig)}
+        assert set(data) <= current, set(data) - current
+        # new-in-current-schema fields materialized with defaults
+        for field_name in ("replica", "expert_parallel", "pipeline_parallel"):
+            assert field_name in data, (fixture, sorted(data))
+        # stale keys gone
+        assert "num_machines" not in data and "dynamo_backend" not in data
+
+    def test_latest_roundtrip_is_stable(self, tmp_path):
+        import shutil
+
+        path = tmp_path / "config.yaml"
+        shutil.copy(os.path.join(self.FIXTURES, "latest.yaml"), path)
+        r = _run(["config", "update", "--config_file", str(path)])
+        assert r.returncode == 0, r.stderr
+        import yaml
+
+        data = yaml.safe_load(open(path))
+        assert data["replica"] == 2
+        assert data["grad_compression_dtype"] == "bfloat16"
+
+
 class TestMergeCommand:
     def test_merge_roundtrip(self, tmp_path):
         from accelerate_tpu.utils.serialization import load_flat_dict, save_pytree

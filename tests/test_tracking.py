@@ -1,0 +1,286 @@
+"""Tracker tests (parity: reference tests/test_tracking.py, 535 LoC).
+
+Two tiers:
+- TensorBoard + JSONL run for REAL: events written to disk and read back
+  through tensorboard's EventAccumulator (the reference asserts on real
+  event dirs the same way).
+- wandb/mlflow/comet_ml/aim/clearml/dvclive are not installed in this
+  image, so each gets an API-faithful fake module injected into
+  sys.modules: the tracker glue (the import-gated code that otherwise
+  never executes) runs for real against the recorded surface, and the
+  test asserts the exact calls each backend's API contract expects.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.tracking import (
+    AimTracker,
+    ClearMLTracker,
+    CometMLTracker,
+    DVCLiveTracker,
+    JSONLTracker,
+    MLflowTracker,
+    TensorBoardTracker,
+    WandBTracker,
+)
+
+
+class _Recorder:
+    """Attribute-path call recorder: fake.a.b(c) logs ('a.b', args, kwargs)."""
+
+    def __init__(self, calls, path=""):
+        self._calls = calls
+        self._path = path
+
+    def __getattr__(self, name):
+        return _Recorder(self._calls, f"{self._path}.{name}" if self._path else name)
+
+    def __call__(self, *args, **kwargs):
+        self._calls.append((self._path, args, kwargs))
+        return _Recorder(self._calls, self._path + "()")
+
+    def names(self):
+        return [c[0] for c in self._calls]
+
+
+class TestJsonlTracker:
+    def test_roundtrip(self, tmp_path):
+        t = JSONLTracker("run", tmp_path)
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 1.5}, step=0)
+        t.log({"loss": 1.0}, step=1)
+        t.finish()
+        lines = [json.loads(l) for l in open(tmp_path / "run" / "metrics.jsonl")]
+        assert lines[0]["event"] == "config" and lines[0]["values"]["lr"] == 0.1
+        assert [l["values"]["loss"] for l in lines[1:]] == [1.5, 1.0]
+
+
+class TestTensorBoardTracker:
+    def test_real_event_dir(self, tmp_path):
+        t = TensorBoardTracker("run", tmp_path)
+        t.store_init_configuration({"lr": 0.1, "label": "x"})
+        t.log({"loss": 2.0}, step=0)
+        t.log({"loss": 1.0, "note": "hi"}, step=1)
+        t.finish()
+        logdir = tmp_path / "run"
+        event_files = [p for p in logdir.rglob("events.out.tfevents.*")]
+        assert event_files, list(logdir.rglob("*"))
+        from tensorboard.backend.event_processing.event_accumulator import (
+            EventAccumulator,
+        )
+
+        acc = EventAccumulator(str(logdir))
+        acc.Reload()
+        assert "loss" in acc.Tags()["scalars"], acc.Tags()
+        steps = [(e.step, e.value) for e in acc.Scalars("loss")]
+        assert (0, 2.0) in steps and (1, 1.0) in steps, steps
+        # hparams sidecar written for humans
+        assert (logdir / "hparams.yml").exists() or (logdir / "hparams.json").exists()
+
+
+@pytest.fixture
+def fake_modules(monkeypatch):
+    """Install API-faithful fakes; yields {module_name: calls list}."""
+    calls: dict[str, list] = {}
+
+    def install(name, module):
+        import importlib.machinery
+
+        # a real-looking spec so importlib.util.find_spec (the is_*_available
+        # probes) accepts the fake
+        module.__spec__ = importlib.machinery.ModuleSpec(name, loader=None)
+        calls[name] = module._calls
+        monkeypatch.setitem(sys.modules, name, module)
+
+    # wandb: init() -> run with log/finish; config.update
+    wandb = types.ModuleType("wandb")
+    wandb._calls = []
+    wandb_run = _Recorder(wandb._calls, "run")
+    wandb.init = lambda **kw: (wandb._calls.append(("init", (), kw)), wandb_run)[1]
+    wandb.config = _Recorder(wandb._calls, "config")
+    install("wandb", wandb)
+
+    # mlflow: set_experiment/start_run/log_params/log_metrics/end_run +
+    # utils.validation.MAX_PARAM_VAL_LENGTH
+    mlflow = types.ModuleType("mlflow")
+    mlflow._calls = []
+    rec = _Recorder(mlflow._calls)
+    mlflow.set_experiment = rec.set_experiment
+    mlflow.start_run = lambda **kw: (mlflow._calls.append(("start_run", (), kw)), "active-run")[1]
+    mlflow.log_params = rec.log_params
+    mlflow.log_metrics = rec.log_metrics
+    mlflow.end_run = rec.end_run
+    mlflow.utils = types.SimpleNamespace(
+        validation=types.SimpleNamespace(MAX_PARAM_VAL_LENGTH=500)
+    )
+    install("mlflow", mlflow)
+
+    # comet_ml: Experiment with log_parameters/set_step/log_metric/...
+    comet = types.ModuleType("comet_ml")
+    comet._calls = []
+    comet.Experiment = lambda **kw: (
+        comet._calls.append(("Experiment", (), kw)),
+        _Recorder(comet._calls, "exp"),
+    )[1]
+    install("comet_ml", comet)
+
+    # aim: Run with dict-style hparams, track, close
+    aim = types.ModuleType("aim")
+    aim._calls = []
+
+    class _AimRun:
+        def __init__(self, **kw):
+            aim._calls.append(("Run", (), kw))
+
+        def __setitem__(self, key, value):
+            aim._calls.append(("run.__setitem__", (key, value), {}))
+
+        def track(self, value, name=None, step=None, **kw):
+            aim._calls.append(("run.track", (value,), {"name": name, "step": step, **kw}))
+
+        def close(self):
+            aim._calls.append(("run.close", (), {}))
+
+    aim.Run = _AimRun
+    install("aim", aim)
+
+    # clearml: Task.current_task/Task.init -> task with logger
+    clearml = types.ModuleType("clearml")
+    clearml._calls = []
+    task = _Recorder(clearml._calls, "task")
+
+    class _Task:
+        @staticmethod
+        def current_task():
+            clearml._calls.append(("Task.current_task", (), {}))
+            return None
+
+        @staticmethod
+        def init(**kw):
+            clearml._calls.append(("Task.init", (), kw))
+            return task
+
+    clearml.Task = _Task
+    install("clearml", clearml)
+
+    # dvclive: Live with log_params/log_metric/step/end
+    dvclive = types.ModuleType("dvclive")
+    dvclive._calls = []
+
+    class _Live:
+        def __init__(self, **kw):
+            dvclive._calls.append(("Live", (), kw))
+            self.step = None
+
+        def log_params(self, params):
+            dvclive._calls.append(("live.log_params", (params,), {}))
+
+        def log_metric(self, k, v, **kw):
+            dvclive._calls.append(("live.log_metric", (k, v), kw))
+
+        def end(self):
+            dvclive._calls.append(("live.end", (), {}))
+
+    dvclive.Live = _Live
+    install("dvclive", dvclive)
+    return calls
+
+
+class TestBackendGlue:
+    """Every import-gated tracker constructs, stores config, logs, and
+    finishes against its backend's documented API."""
+
+    def test_wandb(self, fake_modules):
+        t = WandBTracker("proj", tags=["a"])
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 1.0}, step=3)
+        t.finish()
+        names = [c[0] for c in fake_modules["wandb"]]
+        assert names == ["init", "config.update", "run.log", "run.finish"]
+        init_kw = fake_modules["wandb"][0][2]
+        assert init_kw == {"project": "proj", "tags": ["a"]}
+        log_call = fake_modules["wandb"][2]
+        assert log_call[1] == ({"loss": 1.0},) and log_call[2] == {"step": 3}
+
+    def test_mlflow(self, fake_modules, monkeypatch):
+        monkeypatch.delenv("MLFLOW_EXPERIMENT_NAME", raising=False)
+        t = MLflowTracker("exp")
+        t.store_init_configuration({"lr": 0.1, "huge": "x" * 1000})
+        t.log({"loss": 1.0, "note": "skip-me"}, step=2)
+        t.finish()
+        calls = {c[0]: c for c in fake_modules["mlflow"]}
+        assert calls["set_experiment"][1] == ("exp",)
+        # over-long param dropped (mlflow rejects them server-side)
+        assert calls["log_params"][1] == ({"lr": 0.1},)
+        # only numeric values become metrics
+        assert calls["log_metrics"][1] == ({"loss": 1.0},)
+        assert calls["log_metrics"][2] == {"step": 2}
+        assert "end_run" in calls
+
+    def test_comet(self, fake_modules):
+        t = CometMLTracker("proj")
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 1.0, "tag": "s", "group": {"a": 1.0}}, step=4)
+        t.finish()
+        names = [c[0] for c in fake_modules["comet_ml"]]
+        assert names[0] == "Experiment"
+        assert "exp.log_parameters" in names
+        assert "exp.set_step" in names and "exp.log_metric" in names
+        assert "exp.log_other" in names and "exp.log_metrics" in names
+        assert names[-1] == "exp.end"
+
+    def test_aim(self, fake_modules, tmp_path):
+        t = AimTracker("run", logging_dir=str(tmp_path))
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 1.0}, step=1)
+        t.finish()
+        calls = fake_modules["aim"]
+        assert calls[0][0] == "Run" and calls[0][2] == {"repo": str(tmp_path)}
+        assert ("run.__setitem__", ("hparams", {"lr": 0.1}), {}) in calls
+        track = next(c for c in calls if c[0] == "run.track")
+        assert track[1] == (1.0,) and track[2]["name"] == "loss" and track[2]["step"] == 1
+        assert calls[-1][0] == "run.close"
+
+    def test_clearml(self, fake_modules):
+        t = ClearMLTracker("proj")
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 1.0}, step=5)
+        t.log({"final_note": "done"})
+        t.finish()
+        names = [c[0] for c in fake_modules["clearml"]]
+        assert names[0] == "Task.current_task" and names[1] == "Task.init"
+        assert "task.connect_configuration" in names
+        scalar = next(c for c in fake_modules["clearml"] if c[0] == "task.get_logger().report_scalar")
+        assert scalar[2]["value"] == 1.0 and scalar[2]["iteration"] == 5
+        assert any(c[0] == "task.get_logger().report_single_value" for c in fake_modules["clearml"])
+        assert names[-1] == "task.close"
+
+    def test_dvclive(self, fake_modules):
+        t = DVCLiveTracker("run")
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 1.0}, step=7)
+        t.finish()
+        calls = fake_modules["dvclive"]
+        assert calls[0][0] == "Live"
+        assert ("live.log_params", ({"lr": 0.1},), {}) in calls
+        assert ("live.log_metric", ("loss", 1.0), {}) in calls
+        assert t.live.step == 7
+        assert calls[-1][0] == ("live.end")
+
+    def test_accelerator_routes_to_faked_backend(self, fake_modules, tmp_path):
+        """log_with='wandb' end-to-end through Accelerator.init_trackers/log."""
+        from accelerate_tpu import Accelerator
+
+        accelerator = Accelerator(log_with=WandBTracker("proj"))
+        accelerator.init_trackers("proj", config={"lr": 0.1})
+        accelerator.log({"loss": 2.0}, step=0)
+        accelerator.end_training()
+        names = [c[0] for c in fake_modules["wandb"]]
+        assert "run.log" in names and names[-1] == "run.finish"
