@@ -547,7 +547,12 @@ class TrainEngine:
 
     def clip_grad_norm(self, max_norm: float):
         """Record the clip threshold for the coming update and return the
-        current global grad norm (reference clip_grad_norm_ returns it)."""
+        current global grad norm (reference clip_grad_norm_ returns it).
+
+        Before any backward there are no accumulated grads and the returned
+        norm is 0.0 — the same value torch.nn.utils.clip_grad_norm_ returns
+        when no parameter has a .grad; the threshold still applies to the
+        next update."""
         self._clip_max_norm = float(max_norm)
         if self._accum_grads is None:
             return jnp.asarray(0.0)
@@ -890,6 +895,33 @@ def _merge_static_call(args, kwargs, static_args, static_kw):
     return tuple(args), dict(kwargs, **dict(static_kw))
 
 
+def _looks_like_schedule(fn) -> bool:
+    """True if ``fn`` behaves like an optax schedule: step -> scalar lr.
+    Guards prepare()'s pass 3 from silently wrapping stray callables (e.g. a
+    loss function passed positionally) as schedulers.
+
+    The signature is checked BEFORE probing fn(0), so multi-arg callables
+    (loss functions, factories) are rejected without executing them."""
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+        sig.bind(0)  # must accept exactly one positional argument
+    except TypeError:
+        return False
+    except (ValueError, RuntimeError):  # builtins without signatures: probe
+        pass
+    try:
+        out = fn(0)
+    except Exception:
+        return False
+    if isinstance(out, bool):  # a predicate, not a learning rate
+        return False
+    if isinstance(out, (int, float)):
+        return True
+    return hasattr(out, "shape") and tuple(getattr(out, "shape", (1,))) == ()
+
+
 def _cast_float_outputs(outputs, dtype):
     return recursively_apply(
         lambda t: t.astype(dtype) if jnp.issubdtype(t.dtype, jnp.floating) else t, outputs
@@ -1110,8 +1142,17 @@ class Accelerator:
         # pass 3: schedules (need prepared optimizers)
         for i, obj in enumerate(result):
             if callable(obj) and not isinstance(
-                obj, (PreparedModel, AcceleratedOptimizer, Model)
+                obj, (PreparedModel, AcceleratedOptimizer, AcceleratedScheduler, Model)
             ) and not _is_dataloader_like(obj) and not isinstance(obj, optax.GradientTransformation):
+                if not _looks_like_schedule(obj):
+                    raise TypeError(
+                        f"prepare() received a callable ({obj!r}) that is not an "
+                        "optax schedule (schedule(step:int) must return a scalar "
+                        "learning rate; single-argument candidates are probed "
+                        "with step=0). Loss functions belong on the model "
+                        "(Model(..., loss_fn=...)) or Accelerator(loss_fn=...), "
+                        "not in prepare()."
+                    )
                 result[i] = self.prepare_scheduler(obj)
         return result[0] if len(result) == 1 else tuple(result)
 

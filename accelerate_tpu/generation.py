@@ -48,6 +48,96 @@ _LOOP_CACHE_LIMIT = 32
 # the same clone keeps id(definition) stable so the jitted loops re-hit
 _SIZED_DEF_CACHE: dict = {}
 
+# de-pipelined definition clones, same id-stability trick
+_DEPIPE_DEF_CACHE: dict = {}
+
+
+def depipeline(definition, params):
+    """(definition, params) with pipeline stages folded back into the layer
+    scan — the form autoregressive decoding wants.
+
+    A decode step is inherently SERIAL across pipeline stages (token t+1
+    cannot enter stage 0 before token t left the last stage), so the GPipe
+    schedule buys nothing at generation time; what works is running the
+    stage-stacked layers as one layer scan with a KV cache. Params move from
+    ``pipeline/stages/layers/...`` leaves [S, L/S, ...] to ``layers/...``
+    leaves [L, ...] (the exact inverse of prepare_pippy's remap).
+
+    ``generate()`` applies this automatically, re-mapping params per call;
+    serving loops should call it ONCE up front and keep the converted pair.
+    """
+    cfg = getattr(definition, "config", None)
+    stages = getattr(definition, "_effective_stages", lambda: 1)()
+    if cfg is None or stages <= 1:
+        return definition, params
+
+    key = id(definition)
+    hit = _DEPIPE_DEF_CACHE.get(key)
+    if hit is not None and hit[0] is definition:
+        clone = hit[1]
+        cached = hit[2]
+        first = next(iter(jax.tree_util.tree_leaves(params)), None)
+        if cached is not None and cached[0] is first:
+            return clone, cached[1]  # repeat call, skip the re-layout
+    else:
+        clone = None
+
+    import dataclasses as _dc
+
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    flat = flatten_dict(params, sep="/")
+    out = {}
+    for path, leaf in flat.items():
+        # stage-vmapped layer-scan leaves live under .../stages/layers/
+        # (e.g. pipeline/schedule/stages/layers/block/attn/wq, [S, L/S, ...])
+        if "stages/layers/" in path:
+            tail = path.split("stages/layers/")[-1]
+            out[f"layers/{tail}"] = leaf.reshape(
+                leaf.shape[0] * leaf.shape[1], *leaf.shape[2:]
+            )
+        else:
+            out[path] = leaf
+    new_params = unflatten_dict(out, sep="/")
+
+    if clone is None:
+        new_cfg = _dc.replace(cfg, pipeline_stages=1, scan_layers=True)
+        mesh = getattr(definition, "mesh", None)
+        if mesh is not None and mesh.shape.get("stage", 1) > 1:
+            # keep every non-stage axis (tensor/fsdp/data sharding must
+            # survive decode); the stage devices fold into "data", where the
+            # now layer-scanned params are simply replicated
+            clone = definition.clone(config=new_cfg, mesh=_fold_stage_into_data(mesh))
+        else:
+            clone = definition.clone(config=new_cfg)
+    if len(_DEPIPE_DEF_CACHE) >= _LOOP_CACHE_LIMIT:
+        _DEPIPE_DEF_CACHE.pop(next(iter(_DEPIPE_DEF_CACHE)))
+    first = next(iter(jax.tree_util.tree_leaves(params)), None)
+    _DEPIPE_DEF_CACHE[key] = (definition, clone, (first, new_params))
+    return clone, new_params
+
+
+def _fold_stage_into_data(mesh):
+    """Same devices, stage axis merged into the data axis (stage dropped):
+    decode has no pipeline schedule, so former stage devices act
+    data-parallel (params replicated across them)."""
+    from jax.sharding import Mesh
+
+    names = list(mesh.axis_names)
+    if "stage" not in names or "data" not in names:
+        return None
+    devices = mesh.devices
+    s_ax, d_ax = names.index("stage"), names.index("data")
+    # transpose so stage sits immediately before data, then merge the pair
+    order = [i for i in range(devices.ndim) if i != s_ax]
+    order.insert(order.index(d_ax), s_ax)
+    arr = devices.transpose(order)
+    pos = order.index(s_ax)
+    shape = list(arr.shape)
+    shape[pos:pos + 2] = [shape[pos] * shape[pos + 1]]
+    new_names = [names[i] for i in order if i != s_ax]
+    return Mesh(arr.reshape(shape), tuple(new_names))
+
 _CACHE_BUCKET = 256
 
 
@@ -147,6 +237,7 @@ def generate(
     ensure_persistent_compile_cache()
     input_ids = jnp.asarray(input_ids)
     b, s = input_ids.shape
+    definition, params = depipeline(definition, params)
     definition = _right_size_cache(definition, s, max_new_tokens)
     cfg = getattr(definition, "config", None)
     if cfg is not None:

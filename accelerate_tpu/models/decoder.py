@@ -255,7 +255,12 @@ class DecoderLM(nn.Module):
         cfg = self.config
         b, s = input_ids.shape
         if use_cache and self._effective_stages() > 1:
-            raise NotImplementedError("KV-cache generation is not wired through the pipeline schedule")
+            raise NotImplementedError(
+                "KV-cache decode through the GPipe schedule is not supported "
+                "(a decode step is serial across stages by construction); use "
+                "accelerate_tpu.generation.generate / depipeline(), which fold "
+                "the stage-stacked layers back into the layer scan"
+            )
         if use_cache and cfg.remat:
             raise ValueError("generation needs remat=False (mutable KV cache under jax.checkpoint)")
         embedding = self.param(
