@@ -26,6 +26,16 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def clear_generation_caches():
+    """Drop every module-level generation cache: compiled prefill/decode
+    loops, right-sized definition clones, and de-pipelined param trees
+    (which pin two full weight copies each). Call when retiring models from
+    a long-lived server process."""
+    _LOOP_CACHE.clear()
+    _SIZED_DEF_CACHE.clear()
+    _DEPIPE_DEF_CACHE.clear()
+
+
 def _sample(logits, key, temperature: float, top_k: Optional[int]):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
@@ -63,34 +73,40 @@ def depipeline(definition, params):
     ``pipeline/stages/layers/...`` leaves [S, L/S, ...] to ``layers/...``
     leaves [L, ...] (the exact inverse of prepare_pippy's remap).
 
-    ``generate()`` applies this automatically, re-mapping params per call;
-    serving loops should call it ONCE up front and keep the converted pair.
+    ``generate()`` applies this automatically and caches the converted tree
+    (keyed on the identity of every leaf), which PINS both the original and
+    converted params until eviction or :func:`clear_generation_caches` —
+    serving loops should call depipeline ONCE up front, keep the converted
+    pair, and drop the stacked original.
     """
     cfg = getattr(definition, "config", None)
     stages = getattr(definition, "_effective_stages", lambda: 1)()
     if cfg is None or stages <= 1:
         return definition, params
 
+    leaf_ids = tuple(id(l) for l in jax.tree_util.tree_leaves(params))
     key = id(definition)
     hit = _DEPIPE_DEF_CACHE.get(key)
     if hit is not None and hit[0] is definition:
         clone = hit[1]
         cached = hit[2]
-        first = next(iter(jax.tree_util.tree_leaves(params)), None)
-        if cached is not None and cached[0] is first:
-            return clone, cached[1]  # repeat call, skip the re-layout
+        # cached[0] holds the ORIGINAL tree (strong ref — ids stay valid);
+        # every leaf must be the same object, not just the first
+        if cached is not None and cached[1] == leaf_ids:
+            return clone, cached[2]  # repeat call, skip the re-layout
     else:
         clone = None
 
     import dataclasses as _dc
 
-    from flax.traverse_util import flatten_dict, unflatten_dict
+    from .parallel.pipeline import _flatten_paths, _unflatten_paths
 
-    flat = flatten_dict(params, sep="/")
+    flat = _flatten_paths(params)
     out = {}
     for path, leaf in flat.items():
         # stage-vmapped layer-scan leaves live under .../stages/layers/
         # (e.g. pipeline/schedule/stages/layers/block/attn/wq, [S, L/S, ...])
+        # — the same convention remap_params_to_pipeline writes
         if "stages/layers/" in path:
             tail = path.split("stages/layers/")[-1]
             out[f"layers/{tail}"] = leaf.reshape(
@@ -98,7 +114,7 @@ def depipeline(definition, params):
             )
         else:
             out[path] = leaf
-    new_params = unflatten_dict(out, sep="/")
+    new_params = _unflatten_paths(out)
 
     if clone is None:
         new_cfg = _dc.replace(cfg, pipeline_stages=1, scan_layers=True)
@@ -112,8 +128,10 @@ def depipeline(definition, params):
             clone = definition.clone(config=new_cfg)
     if len(_DEPIPE_DEF_CACHE) >= _LOOP_CACHE_LIMIT:
         _DEPIPE_DEF_CACHE.pop(next(iter(_DEPIPE_DEF_CACHE)))
-    first = next(iter(jax.tree_util.tree_leaves(params)), None)
-    _DEPIPE_DEF_CACHE[key] = (definition, clone, (first, new_params))
+    # NB: pins BOTH trees (original + converted) until evicted or
+    # clear_generation_caches() — the price of skipping the re-layout on
+    # every serving call; see the docstring
+    _DEPIPE_DEF_CACHE[key] = (definition, clone, (params, leaf_ids, new_params))
     return clone, new_params
 
 
@@ -124,8 +142,18 @@ def _fold_stage_into_data(mesh):
     from jax.sharding import Mesh
 
     names = list(mesh.axis_names)
-    if "stage" not in names or "data" not in names:
-        return None
+    if "stage" not in names:
+        return mesh
+    if "data" not in names:
+        # no data axis to merge into: rename "stage" -> "data" (same device
+        # layout; batch specs shard over data, so former stage devices go
+        # data-parallel). Non-stage axes are preserved either way.
+        from jax.sharding import Mesh
+
+        return Mesh(
+            mesh.devices,
+            tuple("data" if n == "stage" else n for n in names),
+        )
     devices = mesh.devices
     s_ax, d_ax = names.index("stage"), names.index("data")
     # transpose so stage sits immediately before data, then merge the pair

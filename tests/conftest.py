@@ -17,8 +17,11 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
-# The suite is compile-bound (hundreds of tiny GSPMD programs on one CPU
-# core). Two levers keep wall time sane; both are overridable:
+# The suite is compile-bound: hundreds of tiny GSPMD programs, each a few
+# seconds of XLA work. Budget on a SINGLE CPU core: full non-slow suite
+# ~9 min (was >20 min before these levers); per-file runs are seconds to a
+# minute. On multicore hosts pytest-xdist (-n auto) divides the compile
+# bill. Two levers keep wall time sane; both are overridable:
 # - skip XLA's optimization pipeline: tests assert semantics, not speed
 #   (~35-65% off the worst tests' compile time)
 # - persist compiled executables across runs in a repo-local cache, so
