@@ -477,6 +477,7 @@ def load_checkpoint_and_dispatch(
     mesh=None,
     rng=None,
     precompile: bool = True,
+    quantization_config=None,
     **sample_kwargs,
 ) -> DispatchedModel:
     """Abstract-init -> auto device map -> stream checkpoint weights straight
@@ -487,7 +488,13 @@ def load_checkpoint_and_dispatch(
     XLA-compiled on a background thread *while* the checkpoint streams from
     disk to its tiers — compile time hides under I/O instead of adding to
     time-to-first-token, and the persistent compile cache makes it a one-time
-    cost across processes."""
+    cost across processes.
+
+    With ``quantization_config`` (the reference's from_pretrained
+    load_in_8bit integration), eligible weights quantize ON THE HOST as they
+    stream off disk, so only packed int8/int4 bytes + scales cross the
+    host->device link and HBM holds the packed form; dequant fuses into the
+    consuming matmuls in-graph."""
     from .utils.compile_cache import ensure_persistent_compile_cache
 
     ensure_persistent_compile_cache()
@@ -495,8 +502,30 @@ def load_checkpoint_and_dispatch(
     abstract_params = abstract["params"] if isinstance(abstract, dict) and "params" in abstract else abstract
     if isinstance(device_map, str):
         if device_map in ("auto", "balanced", "balanced_low_0", "sequential"):
+            budget_tree = abstract_params
+            if quantization_config is not None:
+                # budget with PACKED sizes so quantization actually helps a
+                # model FIT (the load_in_8bit purpose): QuantizedWeight
+                # nodes flatten to their int8 data + scale leaves, which is
+                # exactly the bytes that will occupy HBM
+                from .utils.quantization import _eligible, quantize_abstract
+
+                flat_b = flatten_pytree(abstract_params)
+                budget_tree = unflatten_to_like(
+                    {
+                        p: quantize_abstract(l, quantization_config)
+                        if _eligible(p, l, quantization_config)
+                        else l
+                        for p, l in flat_b.items()
+                    },
+                    abstract_params,
+                )
             device_map = infer_auto_device_map(
-                abstract_params, max_memory=max_memory, dtype=dtype, mode=device_map
+                budget_tree,
+                max_memory=max_memory,
+                # a global dtype override would mis-scale the int8 leaves
+                dtype=None if quantization_config is not None else dtype,
+                mode=device_map,
             )
         else:
             device_map = {"": device_map}
@@ -519,7 +548,16 @@ def load_checkpoint_and_dispatch(
             out_dtype = src.dtype
             if dtype is not None and jnp.issubdtype(out_dtype, jnp.floating):
                 out_dtype = dtype
-            return jax.ShapeDtypeStruct(leaf.shape, out_dtype)
+            sds = jax.ShapeDtypeStruct(leaf.shape, out_dtype)
+            if quantization_config is not None:
+                from .utils.quantization import _eligible, quantize_abstract
+
+                if (
+                    placement_of(path, device_map) == "device"
+                    and _eligible(path, sds, quantization_config)
+                ):
+                    return quantize_abstract(sds, quantization_config)
+            return sds
 
         flat_abs = flatten_pytree(abstract_params)
         cast_abstract = unflatten_to_like(
@@ -544,6 +582,7 @@ def load_checkpoint_and_dispatch(
         offload_folder=offload_folder,
         dtype=dtype,
         mesh=mesh,
+        quantization_config=quantization_config,
     )
     if compile_thread is not None:
         compile_thread.join()

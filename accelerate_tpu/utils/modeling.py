@@ -312,6 +312,7 @@ def load_checkpoint_in_model(
     dtype=None,
     mesh=None,
     sharding_config=None,
+    quantization_config=None,
 ):
     """Route each checkpoint weight to its tier as it is read (reference
     load_checkpoint_in_model:1683): device weights go straight to their
@@ -350,6 +351,31 @@ def load_checkpoint_in_model(
         if dtype is not None and jnp.issubdtype(jnp.dtype(value.dtype), jnp.floating):
             value = value.astype(dtype)
         tier = placement_of(path, device_map)
+        if quantization_config is not None and tier == "device":
+            from .quantization import _eligible, quantize_array_host
+
+            if _eligible(path, value, quantization_config):
+                # quantize ON HOST, then ship only packed bytes + scales:
+                # 2-4x fewer bytes over the (often link-bound) transfer
+                qw = quantize_array_host(
+                    value, bits=quantization_config.bits,
+                    group_size=quantization_config.group_size,
+                )
+                if shardings is not None:
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+
+                    # packed data keeps the fp tensor's shape -> reuse its
+                    # mesh sharding; the (small) scales replicate
+                    qw = type(qw)(
+                        jax.device_put(jnp.asarray(qw.data), shardings[path]),
+                        jax.device_put(jnp.asarray(qw.scale), NamedSharding(mesh, P())),
+                        qw.shape, qw.bits, qw.group, qw.dtype,
+                    )
+                else:
+                    qw = jax.tree_util.tree_map(jnp.asarray, qw)
+                out[path] = qw
+                continue
         if tier == "device":
             if value.base is not None and isinstance(value.base, np.memmap):
                 # lift mmap-backed views into RAM before the transfer: the
