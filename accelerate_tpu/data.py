@@ -705,10 +705,33 @@ def prepare_data_loader(
         use_seedable_sampler = config.use_seedable_sampler
 
     if dispatch_batches:
+        per_bs = _find_batch_size_attr(dataloader, split_batches, num_processes)
+        base = dataloader
+        if not split_batches and num_processes > 1:
+            # reference dispatch semantics: main fetches ONE GLOBAL batch of
+            # per_process_bs x N per step and each process takes its slice —
+            # re-batch the source instead of padding every per-process fetch
+            # N-fold (which would hand trailing ranks pure padding)
+            if type(dataloader) is DataLoader:
+                base = DataLoader(
+                    dataloader.dataset,
+                    batch_size=dataloader.batch_size * num_processes,
+                    shuffle=dataloader.shuffle,
+                    drop_last=dataloader.drop_last,
+                    collate_fn=dataloader.collate_fn,
+                    seed=dataloader.seed,
+                )
+            elif per_bs is not None:
+                # torch loaders / DataLoader subclasses / anything else:
+                # concatenate N consecutive source batches per global fetch,
+                # preserving the source's own iteration logic
+                base = _GlobalRebatch(dataloader, num_processes)
         return DataLoaderDispatcher(
-            dataloader,
-            mesh=mesh,
-            batch_size=_find_batch_size_attr(dataloader, split_batches, num_processes),
+            base,
+            # put_on_device=False keeps batches host-side (each process
+            # holds its slice as numpy), exactly like the shard path
+            mesh=mesh if put_on_device else None,
+            batch_size=per_bs,
             even_batches=even_batches,
         )
 
@@ -724,6 +747,44 @@ def prepare_data_loader(
         even_batches=even_batches,
         device_put=put_on_device,
         prefetch_depth=config.prefetch_depth if config is not None else 0,
+    )
+
+
+class _GlobalRebatch:
+    """Concatenate N consecutive source batches into one global batch (the
+    dispatch-mode re-batch for loaders we cannot rebuild: torch DataLoaders,
+    DataLoader subclasses, generic iterables). The source's own sampling /
+    collation / augmentation logic runs untouched; only the tail can come up
+    short (handled by the dispatcher's ragged-tail padding)."""
+
+    def __init__(self, base, n: int):
+        self.base = base
+        self.n = int(n)
+
+    def __iter__(self):
+        chunk = []
+        for batch in self.base:
+            chunk.append(batch)
+            if len(chunk) == self.n:
+                yield _concat_batches(chunk)
+                chunk = []
+        if chunk:
+            yield _concat_batches(chunk)
+
+    def __len__(self):
+        return -(-len(self.base) // self.n)
+
+
+def _concat_batches(batches: list):
+    if len(batches) == 1:
+        return batches[0]
+    first = batches[0]
+    return jax.tree_util.tree_map(
+        lambda *leaves: np.concatenate([np.asarray(l) for l in leaves], axis=0)
+        if getattr(leaves[0], "ndim", 0) >= 1
+        else leaves[0],
+        first,
+        *batches[1:],
     )
 
 

@@ -210,14 +210,10 @@ def dl_preparation_check(accelerator, dispatch: bool):
         everyone = gather_object([local])
         counts = {len(r) for r in everyone}
         assert len(counts) == 1, (label, counts)  # even batches
-        if dispatch:
-            # rank 0 fetches, everyone receives the same full batch stream
-            for other in everyone[1:]:
-                assert other == everyone[0], (label, length, bs)
-            seen = sorted(int(v) for v in everyone[0])
-        else:
-            # shard mode: disjoint-ish shards union to the dataset
-            seen = sorted(int(v) for rank_items in everyone for v in rank_items)
+        # both modes: per-rank shares union to the dataset, the only
+        # duplicates being even-batch padding (shard wraparound / the
+        # dispatcher's repeated-head ragged-tail fill)
+        seen = sorted(int(v) for rank_items in everyone for v in rank_items)
         assert sorted(set(seen)) == list(range(length)), (label, length, bs, seen)
         assert length <= len(seen) < length + 2 * n * bs, (label, len(seen), length)
     accelerator.print(f"{label} dataloader preparation check OK")
