@@ -24,6 +24,7 @@ Measurement notes (the TPU here is tunnel-attached):
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -67,6 +68,23 @@ def _named_configs(on_tpu: bool):
     return {"ttft_tiny": DecoderConfig.tiny()}
 
 
+def _timed_steps(step, batch, steps):
+    """Run warmup + `steps` timed steps, return (final loss, seconds).
+    NB: device_get, not block_until_ready — the latter does not actually
+    block through remote-attached runtimes, and the final loss value
+    transitively depends on every timed step."""
+    for _ in range(2):
+        metrics = step(batch)
+    float(jax.device_get(metrics["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        metrics = step(batch)
+    loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    return loss, dt
+
+
 def _train_bench(cfg, batch_size, seq_len, steps, mixed_precision):
     """Train `steps` steps, return (tokens/sec, MFU, final loss)."""
     import optax
@@ -89,20 +107,7 @@ def _train_bench(cfg, batch_size, seq_len, steps, mixed_precision):
     ids = rng.randint(0, cfg.vocab_size, (batch_size, seq_len))
     batch = accelerator.prepare_for_eval({"input_ids": ids, "labels": ids})
 
-    # warmup / compile. NB: device_get, not block_until_ready — the latter
-    # does not actually block through remote-attached runtimes, and the
-    # final loss value transitively depends on every timed step.
-    for _ in range(2):
-        metrics = step(batch)
-    float(jax.device_get(metrics["loss"]))
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        metrics = step(batch)
-    final_loss = float(jax.device_get(metrics["loss"]))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
-
+    final_loss, dt = _timed_steps(step, batch, steps)
     tokens_per_sec = batch_size * seq_len * steps / dt
     # FLOPs/token: 6N weight FLOPs + causal attention 6*L*S*E
     flops_per_token = 6 * cfg.num_params + 6 * cfg.num_layers * seq_len * cfg.embed_dim
@@ -110,13 +115,92 @@ def _train_bench(cfg, batch_size, seq_len, steps, mixed_precision):
     return tokens_per_sec, mfu, final_loss, dt / steps
 
 
+def _encoder_bench(batch_size, seq_len, steps):
+    """BERT-base fine-tune throughput (the BASELINE nlp_example row:
+    samples/sec/chip + MFU)."""
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+    from accelerate_tpu.state import AcceleratorState
+
+    AcceleratorState._reset_state(reset_partial_state=False)
+    accelerator = Accelerator(mixed_precision="bf16")
+    cfg = EncoderConfig.bert_base()
+    model_def = EncoderClassifier(cfg, mesh=accelerator.mesh)
+    variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=batch_size, seq_len=seq_len)
+    model, optimizer = accelerator.prepare(Model(model_def, variables), optax.adamw(2e-5))
+
+    def loss_fn(apply_fn, params, batch):
+        # dropout ACTIVE, like the reference's MRPC fine-tune
+        return apply_fn(
+            params,
+            batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            labels=batch["labels"],
+            deterministic=False,
+        )["loss"]
+
+    step = accelerator.build_train_step(loss_fn=loss_fn)
+    rng = np.random.RandomState(0)
+    batch = accelerator.prepare_for_eval({
+        "input_ids": rng.randint(0, cfg.vocab_size, (batch_size, seq_len)),
+        "attention_mask": np.ones((batch_size, seq_len), np.int32),
+        "labels": rng.randint(0, cfg.num_labels, (batch_size,)),
+    })
+    _, dt = _timed_steps(step, batch, steps)
+    samples_per_sec = batch_size * steps / dt
+    # matmul params only: embedding/position/type tables are gathers, not
+    # matmuls (unlike the decoder, whose tied embedding IS the lm-head
+    # matmul); attention term is 2x the causal convention (bidirectional)
+    from accelerate_tpu.utils.serialization import flatten_pytree
+
+    n_matmul = sum(
+        int(np.prod(l.shape))
+        for p, l in flatten_pytree(variables["params"]).items()
+        if "embedding" not in p.lower()
+    )
+    flops_per_sample = (6 * n_matmul + 12 * cfg.num_layers * seq_len * cfg.embed_dim) * seq_len
+    mfu = samples_per_sec * flops_per_sample / _peak_flops(jax.devices()[0])
+    return samples_per_sec, mfu
+
+
+def _resnet_bench(batch_size, image_size, steps):
+    """ResNet-50 training throughput (the BASELINE cv_example row:
+    samples/sec/chip)."""
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import ResNet, VisionConfig
+    from accelerate_tpu.state import AcceleratorState
+
+    AcceleratorState._reset_state(reset_partial_state=False)
+    accelerator = Accelerator(mixed_precision="bf16")
+    cfg = VisionConfig.resnet50(image_size=image_size)
+    model_def = ResNet(cfg)
+    variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=batch_size, image_size=image_size)
+    model, optimizer = accelerator.prepare(
+        Model(model_def, variables), optax.sgd(0.1, momentum=0.9)
+    )
+
+    def loss_fn(apply_fn, params, batch):
+        return apply_fn(params, batch["images"], labels=batch["labels"], train=True)["loss"]
+
+    step = accelerator.build_train_step(loss_fn=loss_fn)
+    rng = np.random.RandomState(0)
+    batch = accelerator.prepare_for_eval({
+        "images": rng.standard_normal((batch_size, image_size, image_size, 3)).astype(np.float32),
+        "labels": rng.randint(0, cfg.num_classes, (batch_size,)),
+    })
+    _, dt = _timed_steps(step, batch, steps)
+    return batch_size * steps / dt
+
+
 def _write_host_checkpoint(cfg, prompt_len, tmpdir):
     """Build a random checkpoint entirely host-side (shapes via eval_shape,
     numpy fill — no device traffic) and save it in the serving dtype. The
     BASELINE table's fp16 rows load half-precision checkpoints; bf16 is the
     TPU-native analog."""
-    import os
-
     import ml_dtypes
 
     from accelerate_tpu.big_modeling import init_empty_weights
@@ -250,8 +334,6 @@ def main():
     if args._ttft_worker:
         name, prompt, tmpdir = args._ttft_worker
         cfg = _named_configs(on_tpu)[name]
-        import os
-
         ckpt = os.path.join(tmpdir, "model.safetensors")
         print(f"TTFT {_ttft_once(cfg, ckpt, int(prompt), int8=args._ttft_int8):.3f}")
         return
@@ -259,6 +341,10 @@ def main():
     extra = {}
 
     if on_tpu:
+        # TPU-native PRNG for the dropout streams (utils/random.KeyChain):
+        # threefry costs ~25% of a dropout-0.1 BERT step on v5e
+        os.environ.setdefault("ATT_PRNG_IMPL", "rbg")
+
         flagship = DecoderConfig(
             vocab_size=32_000, num_layers=12, embed_dim=1536, num_heads=12,
             num_kv_heads=12, mlp_dim=4096, max_seq_len=2048,
@@ -290,6 +376,12 @@ def main():
         lc_tok_s, lc_mfu, _, _ = _train_bench(longctx, 2, 16_384, 4, "bf16")
         extra["long16k_train_mfu_pct"] = round(lc_mfu * 100, 2)
         extra["long16k_tokens_per_sec"] = round(lc_tok_s)
+
+        # the BASELINE nlp_example / cv_example rows (samples/sec/chip)
+        enc_sps, enc_mfu = _encoder_bench(64, 128, 12)
+        extra["bert_base_samples_per_sec"] = round(enc_sps)
+        extra["bert_base_train_mfu_pct"] = round(enc_mfu * 100, 2)
+        extra["resnet50_samples_per_sec"] = round(_resnet_bench(64, 224, 12))
 
         long32k = DecoderConfig(
             vocab_size=32_000, num_layers=8, embed_dim=1024, num_heads=8,
