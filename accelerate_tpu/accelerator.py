@@ -900,8 +900,16 @@ def _looks_like_schedule(fn) -> bool:
     Guards prepare()'s pass 3 from silently wrapping stray callables (e.g. a
     loss function passed positionally) as schedulers.
 
-    The signature is checked BEFORE probing fn(0), so multi-arg callables
-    (loss functions, factories) are rejected without executing them."""
+    Detection order (to avoid executing user code where possible):
+    1. the signature is checked, so multi-arg callables (loss functions,
+       factories) are rejected without executing them;
+    2. single-arg callables whose ``__module__``/``__wrapped__`` come from
+       optax are accepted without probing (covers every optax.schedules
+       factory);
+    3. remaining single-argument callables ARE probed with ``fn(0)`` — a
+       side-effecting closure will observe a fake step-0 call. Pass such
+       callables through ``Accelerator.prepare_scheduler`` explicitly to
+       skip prepare()'s probing entirely."""
     import inspect
 
     try:
@@ -911,6 +919,13 @@ def _looks_like_schedule(fn) -> bool:
         return False
     except (ValueError, RuntimeError):  # builtins without signatures: probe
         pass
+    # single-arg callables minted by optax (schedule factories return
+    # closures from optax.schedules.*) are schedules — skip the probe. The
+    # signature check above still ran, so optax LOSS functions (2+ args)
+    # were already rejected without this fast path ever seeing them.
+    probed = fn.func if isinstance(fn, functools.partial) else getattr(fn, "__wrapped__", fn)
+    if (getattr(probed, "__module__", "") or "").split(".")[0] == "optax":
+        return True
     try:
         out = fn(0)
     except Exception:

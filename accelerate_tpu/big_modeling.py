@@ -508,18 +508,9 @@ def load_checkpoint_and_dispatch(
                 # model FIT (the load_in_8bit purpose): QuantizedWeight
                 # nodes flatten to their int8 data + scale leaves, which is
                 # exactly the bytes that will occupy HBM
-                from .utils.quantization import _eligible, quantize_abstract
+                from .utils.quantization import quantize_abstract_tree
 
-                flat_b = flatten_pytree(abstract_params)
-                budget_tree = unflatten_to_like(
-                    {
-                        p: quantize_abstract(l, quantization_config)
-                        if _eligible(p, l, quantization_config)
-                        else l
-                        for p, l in flat_b.items()
-                    },
-                    abstract_params,
-                )
+                budget_tree = quantize_abstract_tree(abstract_params, quantization_config)
             device_map = infer_auto_device_map(
                 budget_tree,
                 max_memory=max_memory,
@@ -539,29 +530,22 @@ def load_checkpoint_and_dispatch(
         # Dtypes come from the checkpoint HEADER (a bf16 checkpoint loads as
         # bf16 regardless of the model's init dtype), with the explicit
         # ``dtype`` override applied the same way the loader applies it.
+        from .utils.quantization import quantize_abstract_tree
         from .utils.serialization import peek_flat_structs
 
         peeked = peek_flat_structs(checkpoint) or {}
 
-        def _cast(path, leaf):
-            src = peeked.get(path, leaf)
-            out_dtype = src.dtype
+        def _header_dtype(path, leaf):
+            out_dtype = peeked.get(path, leaf).dtype
             if dtype is not None and jnp.issubdtype(out_dtype, jnp.floating):
                 out_dtype = dtype
-            sds = jax.ShapeDtypeStruct(leaf.shape, out_dtype)
-            if quantization_config is not None:
-                from .utils.quantization import _eligible, quantize_abstract
+            return out_dtype
 
-                if (
-                    placement_of(path, device_map) == "device"
-                    and _eligible(path, sds, quantization_config)
-                ):
-                    return quantize_abstract(sds, quantization_config)
-            return sds
-
-        flat_abs = flatten_pytree(abstract_params)
-        cast_abstract = unflatten_to_like(
-            {p: _cast(p, l) for p, l in flat_abs.items()}, abstract_params
+        cast_abstract = quantize_abstract_tree(
+            abstract_params,
+            quantization_config,
+            placement=lambda p: placement_of(p, device_map) == "device",
+            leaf_dtype=_header_dtype,
         )
         model = DispatchedModel(definition, cast_abstract, mesh=mesh, device_map=device_map)
         import threading

@@ -36,6 +36,15 @@ def clear_generation_caches():
     _DEPIPE_DEF_CACHE.clear()
 
 
+@jax.jit
+def _sync_probe(x):
+    """Tiny fully-replicated scalar depending on all of ``x`` — device_get of
+    this forces completion of everything ``x`` depends on without fetching or
+    re-committing ``x`` itself (multi-host safe: scalar jit outputs are
+    replicated, so every host holds an addressable copy)."""
+    return jnp.sum(x).astype(jnp.int32)
+
+
 def _sample(logits, key, temperature: float, top_k: Optional[int]):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
@@ -285,10 +294,15 @@ def generate(
     t0 = time.perf_counter()
     last, cache = prefill(params, input_ids, prefill_rng)
     if return_prefill_seconds:
-        # device_get, not block_until_ready: the latter does not actually
-        # block through remote-attached runtimes, and `last` transitively
-        # depends on the whole prefill. Only the timed path pays the sync.
-        last = jnp.asarray(jax.device_get(last))
+        # Force completion by device_get of a tiny scalar reduction rather
+        # than block_until_ready (which does not actually block through
+        # remote-attached runtimes) or device_get(last) (which would fail on
+        # multi-host meshes where `last` spans non-addressable devices, and
+        # on one host would re-commit `last` to the default device, dropping
+        # its sharding and retracing the decode loop). The scalar jit output
+        # is fully replicated, so every host can fetch it; `last` itself is
+        # left untouched for the decode loop.
+        jax.device_get(_sync_probe(last))
     prefill_seconds = time.perf_counter() - t0
 
     loop = _decode_loop_for(definition, max_new_tokens - 1, temperature, top_k, param_placer)

@@ -27,17 +27,38 @@ def ensure_persistent_compile_cache(cache_dir: str | None = None) -> str | None:
     """Idempotently enable the JAX persistent compilation cache.
 
     Resolution order: explicit ``cache_dir`` arg > ``ATT_COMPILE_CACHE`` env
-    ("0"/"false"/"" disables) > ``~/.cache/accelerate_tpu/xla_cache``.
+    ("0"/"false"/"" disables, "1"/"true" enables at the default location,
+    anything else is a path) > a cache dir the user already configured via
+    ``JAX_COMPILATION_CACHE_DIR`` / ``jax.config`` (respected, not clobbered)
+    > ``~/.cache/accelerate_tpu/xla_cache``.
     Returns the active cache dir (None when disabled)."""
     global _enabled_dir
     env = os.environ.get("ATT_COMPILE_CACHE")
+    import jax
+
     if cache_dir is None:
         if env is not None and env.lower() in ("0", "false", ""):
             return None
+        if env is not None and env.lower() in ("1", "true"):
+            env = _DEFAULT_DIR
+        if env is None:
+            if _enabled_dir is not None:
+                # already enabled by us — don't re-read jax.config (it now
+                # holds OUR dir, which must not be misread as user config)
+                return _enabled_dir
+            # Respect a cache the user configured themselves: keep their dir
+            # and their thresholds. jax only reads JAX_COMPILATION_CACHE_DIR
+            # at import, so re-apply it through jax.config (idempotent) in
+            # case the env var was set after `import jax`.
+            user_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or jax.config.jax_compilation_cache_dir
+            if user_dir:
+                os.makedirs(user_dir, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir", user_dir)
+                _enabled_dir = user_dir
+                return _enabled_dir
         cache_dir = env or _DEFAULT_DIR
     if _enabled_dir == cache_dir:
         return _enabled_dir
-    import jax
 
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -336,9 +336,36 @@ def load_checkpoint_in_model(
 
     shardings = None
     if mesh is not None:
+        infer_tree = abstract_params
+        if quantization_config is not None:
+            # Infer shardings on the PACKED shapes (quantize_abstract), not
+            # the fp shapes: int4 halves dim 0, so the fp-inferred spec can
+            # pick a now-indivisible dim — and would disagree with
+            # DispatchedModel._abstract_params (which infers from the packed
+            # leaves), silently defeating the AOT fast path. Eligibility is
+            # judged on the dtype the load loop will actually see (checkpoint
+            # dtype + cast override), not the model's init dtype — a
+            # disagreement would desync the flat keys below. QuantizedWeight
+            # flattens to data/scale children, so quantized keys become
+            # "<path>/0" (data) and "<path>/1" (scale) — same keys
+            # _abstract_params sees.
+            from .quantization import quantize_abstract_tree
+
+            def _loaded_dtype(path, leaf):
+                dt = jnp.dtype(flat_loaded[path].dtype)
+                if dtype is not None and jnp.issubdtype(dt, jnp.floating):
+                    dt = jnp.dtype(dtype)
+                return dt
+
+            infer_tree = quantize_abstract_tree(
+                abstract_params,
+                quantization_config,
+                placement=lambda p: placement_of(p, device_map) == "device",
+                leaf_dtype=_loaded_dtype,
+            )
         shardings = flatten_pytree(
             infer_param_sharding(
-                abstract_params, mesh, sharding_config or ShardingConfig()
+                infer_tree, mesh, sharding_config or ShardingConfig()
             )
         )
 
@@ -362,14 +389,11 @@ def load_checkpoint_in_model(
                     group_size=quantization_config.group_size,
                 )
                 if shardings is not None:
-                    from jax.sharding import NamedSharding
-                    from jax.sharding import PartitionSpec as P
-
-                    # packed data keeps the fp tensor's shape -> reuse its
-                    # mesh sharding; the (small) scales replicate
+                    # shardings were inferred on the packed shapes above, so
+                    # the data/scale children have their own entries
                     qw = type(qw)(
-                        jax.device_put(jnp.asarray(qw.data), shardings[path]),
-                        jax.device_put(jnp.asarray(qw.scale), NamedSharding(mesh, P())),
+                        jax.device_put(jnp.asarray(qw.data), shardings[path + "/0"]),
+                        jax.device_put(jnp.asarray(qw.scale), shardings[path + "/1"]),
                         qw.shape, qw.bits, qw.group, qw.dtype,
                     )
                 else:

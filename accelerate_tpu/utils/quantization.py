@@ -143,6 +143,38 @@ def quantize_abstract(leaf, config: QuantizationConfig) -> QuantizedWeight:
     )
 
 
+def quantize_abstract_tree(abstract_params, config, *, placement=None, leaf_dtype=None):
+    """``abstract_params`` with every eligible leaf replaced by its
+    ``quantize_abstract`` shadow — the single owner of the "which leaves get
+    packed, and at what dtype" decision shared by the auto-device-map budget,
+    the dispatch AOT precompile, and the loader's sharding inference (so they
+    can never drift apart).
+
+    ``placement(path) -> bool`` gates quantization (e.g. device-tier only);
+    ``leaf_dtype(path, leaf) -> dtype`` overrides the dtype used BOTH for
+    eligibility and for the returned struct (e.g. the checkpoint's on-disk
+    dtype plus a cast override — eligibility must be judged on what will
+    actually be loaded, not on the model's init dtype). With ``config=None``
+    only the dtype adjustment applies."""
+    from .serialization import flatten_pytree, unflatten_to_like
+
+    flat = flatten_pytree(abstract_params)
+    out = {}
+    for path, leaf in flat.items():
+        sds = leaf
+        if leaf_dtype is not None:
+            sds = jax.ShapeDtypeStruct(tuple(leaf.shape), jnp.dtype(leaf_dtype(path, leaf)))
+        if (
+            config is not None
+            and (placement is None or placement(path))
+            and _eligible(path, sds, config)
+        ):
+            out[path] = quantize_abstract(sds, config)
+        else:
+            out[path] = sds
+    return unflatten_to_like(out, abstract_params)
+
+
 def dequantize_array(qw: QuantizedWeight):
     """Inverse of quantize_array; XLA fuses this into the consumer matmul."""
     data = qw.data

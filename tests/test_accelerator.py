@@ -140,6 +140,28 @@ class TestTrainingLoop:
         # 4 batches, accum 2 -> schedule advanced twice
         assert lrs == pytest.approx([0.1, 0.09, 0.09, 0.08])
 
+    def test_schedule_detection_orders_signature_before_optax_fast_path(self):
+        """prepare()'s schedule probe: optax factory closures are accepted
+        WITHOUT being called; optax multi-arg losses are rejected by the
+        signature check before the optax fast path can see them; non-optax
+        side-effecting single-arg callables are probed (documented)."""
+        import functools
+
+        from accelerate_tpu.accelerator import _looks_like_schedule
+
+        assert _looks_like_schedule(optax.linear_schedule(1e-3, 1e-4, 10))
+        assert _looks_like_schedule(functools.partial(optax.linear_schedule(1e-3, 1e-4, 10)))
+        assert not _looks_like_schedule(optax.softmax_cross_entropy)
+
+        calls = []
+
+        def not_a_schedule(step):
+            calls.append(step)
+            return "nope"
+
+        assert not _looks_like_schedule(not_a_schedule)
+        assert calls == [0]  # probing of unknown callables is documented
+
     def test_detached_scheduler_follows_manual_steps_and_warns_on_drift(self):
         import warnings
 

@@ -1,0 +1,71 @@
+"""ATT_COMPILE_CACHE / JAX_COMPILATION_CACHE_DIR resolution in
+utils/compile_cache.py (library must not clobber user cache config)."""
+
+import os
+
+import jax
+import pytest
+
+import accelerate_tpu.utils.compile_cache as cc
+
+
+@pytest.fixture()
+def cache_state(monkeypatch, tmp_path):
+    """Snapshot/restore the module + jax config state these tests mutate
+    (conftest enables a shared test cache for the whole suite)."""
+    prev_enabled = cc._enabled_dir
+    prev_jax_dir = jax.config.jax_compilation_cache_dir
+    monkeypatch.delenv("ATT_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    yield monkeypatch, tmp_path
+    cc._enabled_dir = prev_enabled
+    jax.config.update("jax_compilation_cache_dir", prev_jax_dir)
+
+
+def test_env_1_means_default_dir_not_a_path(cache_state):
+    monkeypatch, _ = cache_state
+    cc._enabled_dir = None
+    monkeypatch.setenv("ATT_COMPILE_CACHE", "1")
+    assert cc.ensure_persistent_compile_cache() == cc._DEFAULT_DIR
+    assert not os.path.exists(os.path.join(os.getcwd(), "1"))
+    cc._enabled_dir = None
+    monkeypatch.setenv("ATT_COMPILE_CACHE", "true")
+    assert cc.ensure_persistent_compile_cache() == cc._DEFAULT_DIR
+
+
+def test_env_0_disables(cache_state):
+    monkeypatch, _ = cache_state
+    cc._enabled_dir = None
+    monkeypatch.setenv("ATT_COMPILE_CACHE", "0")
+    assert cc.ensure_persistent_compile_cache() is None
+
+
+def test_env_path_relocates(cache_state):
+    monkeypatch, tmp_path = cache_state
+    cc._enabled_dir = None
+    target = str(tmp_path / "relocated")
+    monkeypatch.setenv("ATT_COMPILE_CACHE", target)
+    assert cc.ensure_persistent_compile_cache() == target
+    assert os.path.isdir(target)
+
+
+def test_user_jax_cache_dir_respected_and_applied(cache_state):
+    monkeypatch, tmp_path = cache_state
+    cc._enabled_dir = None
+    user = str(tmp_path / "usercache")
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", user)
+    assert cc.ensure_persistent_compile_cache() == user
+    # applied, not just reported: jax only reads the env var at import time
+    assert jax.config.jax_compilation_cache_dir == user
+    assert os.path.isdir(user)
+
+
+def test_self_set_dir_not_misread_as_user_config(cache_state):
+    """After we enable the default dir, later no-arg calls must hit the
+    idempotent early-return, not re-classify our own dir as user config
+    (generate() calls this on every invocation, incl. from the AOT thread)."""
+    monkeypatch, _ = cache_state
+    cc._enabled_dir = None
+    first = cc.ensure_persistent_compile_cache()
+    assert first == cc._DEFAULT_DIR
+    assert cc.ensure_persistent_compile_cache() is first

@@ -141,3 +141,120 @@ class TestWeightOnlyQuant:
         back = load_flat_dict(str(tmp_path / "q.safetensors"))
         # pytree flattening exposes data + scale as separate tensors
         assert any("w" in k for k in back)
+
+class TestQuantizeAbstractTree:
+    """quantize_abstract_tree is the single owner of the which-leaves-pack
+    decision shared by the device-map budget, the AOT precompile, and the
+    loader's sharding inference — its gating must match the load loop."""
+
+    def _abstract(self):
+        return {
+            "embedding": jax.ShapeDtypeStruct((32, 8), jnp.float32),
+            "layers": {
+                "w_gate": jax.ShapeDtypeStruct((16, 8), jnp.float32),
+                "ln": jax.ShapeDtypeStruct((8,), jnp.float32),
+            },
+        }
+
+    def test_eligible_leaves_become_packed_structs(self):
+        from accelerate_tpu.utils.quantization import quantize_abstract_tree
+
+        out = quantize_abstract_tree(
+            self._abstract(), QuantizationConfig(load_in_4bit=True, group_size=8)
+        )
+        assert isinstance(out["layers"]["w_gate"], QuantizedWeight)
+        assert out["layers"]["w_gate"].data.shape == (8, 8)  # int4: dim0 halves
+        assert not isinstance(out["embedding"], QuantizedWeight)  # skip_modules
+        assert not isinstance(out["layers"]["ln"], QuantizedWeight)  # vector
+
+    def test_placement_gate(self):
+        from accelerate_tpu.utils.quantization import quantize_abstract_tree
+
+        out = quantize_abstract_tree(
+            self._abstract(),
+            QuantizationConfig(load_in_8bit=True, group_size=8),
+            placement=lambda p: False,
+        )
+        assert not any(
+            isinstance(l, QuantizedWeight)
+            for l in jax.tree_util.tree_leaves(
+                out, is_leaf=lambda l: isinstance(l, QuantizedWeight)
+            )
+        )
+
+    def test_leaf_dtype_drives_eligibility(self):
+        """Eligibility must be judged on what will actually load (checkpoint
+        dtype), not the model's init dtype: an int-dtype override must make
+        the leaf ineligible even though the abstract leaf is floating."""
+        from accelerate_tpu.utils.quantization import quantize_abstract_tree
+
+        out = quantize_abstract_tree(
+            self._abstract(),
+            QuantizationConfig(load_in_8bit=True, group_size=8),
+            leaf_dtype=lambda p, l: jnp.int32 if p == "layers/w_gate" else l.dtype,
+        )
+        assert not isinstance(out["layers"]["w_gate"], QuantizedWeight)
+        assert out["layers"]["w_gate"].dtype == jnp.int32
+
+    def test_config_none_applies_dtype_only(self):
+        from accelerate_tpu.utils.quantization import quantize_abstract_tree
+
+        out = quantize_abstract_tree(
+            self._abstract(), None, leaf_dtype=lambda p, l: jnp.bfloat16
+        )
+        assert out["layers"]["w_gate"].dtype == jnp.bfloat16
+        assert not isinstance(out["layers"]["w_gate"], QuantizedWeight)
+
+    def test_packed_flat_keys_are_path_0_and_1(self):
+        """The loader looks up shardings by "<path>/0"/"<path>/1" — pin the
+        QuantizedWeight flattening order/key scheme that contract rests on."""
+        from accelerate_tpu.utils.quantization import quantize_abstract_tree
+        from accelerate_tpu.utils.serialization import flatten_pytree
+
+        out = quantize_abstract_tree(
+            self._abstract(), QuantizationConfig(load_in_8bit=True, group_size=8)
+        )
+        flat = flatten_pytree(out)
+        assert flat["layers/w_gate/0"].dtype == jnp.int8  # data child
+        assert flat["layers/w_gate/1"].dtype == jnp.float32  # scale child
+
+
+class TestQuantizedMeshLoad:
+    def test_int4_load_shardings_match_abstract_params(self, tmp_path):
+        """Int4 halves dim 0 of the packed data, so loader shardings must be
+        inferred on PACKED shapes; a mismatch with _abstract_params defeats
+        the dispatch AOT fast path (ADVICE r3)."""
+        from jax.sharding import Mesh
+
+        from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+        from accelerate_tpu.models import DecoderConfig, DecoderLM
+        from accelerate_tpu.parallel.sharding import unbox_params
+        from accelerate_tpu.utils.serialization import flatten_pytree, save_pytree
+
+        cfg = DecoderConfig.tiny()
+        model = DecoderLM(cfg)
+        variables = model.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+        params, _ = unbox_params(variables["params"])
+        ckpt = tmp_path / "model.safetensors"
+        save_pytree(params, str(ckpt))
+
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "fsdp"))
+        sample = jnp.zeros((1, 8), jnp.int32)
+        dm = load_checkpoint_and_dispatch(
+            model, str(ckpt), sample,
+            device_map="auto", mesh=mesh,
+            quantization_config=QuantizationConfig(load_in_4bit=True, group_size=16),
+            rng=jax.random.PRNGKey(0),
+        )
+        abs_flat = flatten_pytree(dm._abstract_params())
+        par_flat = flatten_pytree(dm.params)
+        n_packed = 0
+        for path, leaf in par_flat.items():
+            a = abs_flat[path]
+            assert tuple(leaf.shape) == tuple(a.shape), path
+            if getattr(a, "sharding", None) is not None and hasattr(leaf, "sharding"):
+                assert leaf.sharding.is_equivalent_to(a.sharding, len(leaf.shape)), path
+            n_packed += path.endswith("/0")
+        assert n_packed > 0
+        out = dm(sample)
+        assert np.isfinite(np.asarray(out["logits"])).all()
