@@ -249,6 +249,15 @@ class TrainEngine:
         self.loss_fn = model.loss_fn or _default_loss_selector
         self._jit_cache: dict = {}
         self.donate_state = accelerator.compile_plugin.donate_state
+        # models can own their backward schedule (DecoderLM 1f1b pipeline:
+        # interleaved per-microbatch fwd/bwd that reverse-mode AD cannot
+        # express). Only usable when the loss comes from the model itself —
+        # a user loss_fn would be silently ignored by the manual path.
+        self._manual_vag = None
+        if model.loss_fn is None:
+            getter = getattr(model.definition, "pipeline_value_and_grad", None)
+            if getter is not None:
+                self._manual_vag = getter()
 
     # ------------------------------------------------------------------
     # model apply plumbing
@@ -286,6 +295,21 @@ class TrainEngine:
 
     def _fwd_bwd_fn(self, params, extra_state, scale, rng_key, args, kwargs):
         """outputs + grads in one computation (see module docstring)."""
+        if self._manual_vag is not None and not extra_state:
+            ids, labels = _extract_lm_batch(args, kwargs)
+            if labels is not None:
+                loss, grads = self._manual_vag(self._cast_params(params), ids, labels)
+                loss = loss.astype(jnp.float32)
+                grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+                if scale is not None:
+                    finite = jnp.all(
+                        jnp.asarray(
+                            [jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)]
+                        )
+                    )
+                else:
+                    finite = jnp.asarray(True)
+                return {"loss": loss}, extra_state, grads, finite, loss
 
         def loss_of(p):
             outputs, new_state = self._apply(
@@ -655,6 +679,8 @@ class TrainEngine:
             )
             return self.loss_fn(outputs).astype(jnp.float32), new_state
 
+        manual_vag = self._manual_vag if user_loss is None else None
+
         def step_fn(params, opt_state, extra_state, scale_state, rng_key, batch):
             scale = scale_state["scale"] if scale_state is not None else None
 
@@ -662,11 +688,24 @@ class TrainEngine:
                 acc, loss_acc, key, es = carry
                 key, sub = jax.random.split(key)
 
-                def scaled_loss(p):
-                    l, new_es = loss_and_state(p, es, sub, mb)
-                    return (l * scale if scale is not None else l), (l, new_es)
+                args, kwargs = _batch_to_call(mb)
+                ids, labels = _extract_lm_batch(args, kwargs)
+                if manual_vag is not None and not es and labels is not None:
+                    # model-owned backward schedule (1f1b pipeline): grads
+                    # come unscaled; re-scale so the post-scan /scale and
+                    # finite check see the same convention as the AD path
+                    l, g = manual_vag(self._cast_params(params), ids, labels)
+                    l = l.astype(jnp.float32)
+                    if scale is not None:
+                        g = jax.tree_util.tree_map(lambda x: x * scale, g)
+                    new_es = es
+                else:
 
-                g, (l, new_es) = jax.grad(scaled_loss, has_aux=True)(params)
+                    def scaled_loss(p):
+                        l, new_es = loss_and_state(p, es, sub, mb)
+                        return (l * scale if scale is not None else l), (l, new_es)
+
+                    g, (l, new_es) = jax.grad(scaled_loss, has_aux=True)(params)
                 acc = jax.tree_util.tree_map(
                     lambda a, x: a + x.astype(jnp.float32) / micro, acc, g
                 )
@@ -949,6 +988,18 @@ def _batch_to_call(batch):
     if isinstance(batch, (tuple, list)):
         return tuple(batch), {}
     return (batch,), {}
+
+
+def _extract_lm_batch(args, kwargs):
+    """(input_ids, labels) from a causal-LM call signature, or (None, None)
+    when the call carries ANYTHING else (positions, deterministic, masks…) —
+    a manual pipeline backward only covers the plain (input_ids, labels)
+    signature, and silently dropping extra inputs would diverge from AD."""
+    if len(args) > 2 or any(k not in ("input_ids", "labels") for k in kwargs):
+        return None, None
+    ids = args[0] if args else kwargs.get("input_ids")
+    labels = kwargs.get("labels", args[1] if len(args) > 1 else None)
+    return ids, labels
 
 
 class Accelerator:

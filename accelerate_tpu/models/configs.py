@@ -48,9 +48,18 @@ class DecoderConfig:
     scan_layers: bool = True
     fused_ce_chunks: int = 8
     # pipeline parallelism over the mesh "stage" axis: stage-stacked layer
-    # params + GPipe microbatch schedule (parallel/pipeline.py)
+    # params + microbatch schedule (parallel/pipeline.py)
     pipeline_stages: int = 1
     pipeline_microbatches: Optional[int] = None  # None -> pipeline_stages
+    # training schedule for the stage loop:
+    #   "gpipe" — the forward belt under reverse-mode AD (all-forward-then-
+    #     all-backward; per-stage activation stash grows with M);
+    #   "1f1b"  — manual interleaved fwd/bwd (parallel/pipeline.one_f_one_b):
+    #     per-stage stash is O(S) regardless of M, so microbatch count can
+    #     amortize the bubble at constant activation memory. Used by
+    #     TrainEngine via DecoderLM.pipeline_value_and_grad; forward-only
+    #     calls (eval/generation) are schedule-independent.
+    pipeline_schedule: str = "gpipe"
     # KV-cache length for generation (None -> max_seq_len)
     max_cache_len: Optional[int] = None
     # fp8 recipe (ops/fp8.py): MLP contractions run e4m3-fwd/e5m2-bwd with
@@ -80,6 +89,16 @@ class DecoderConfig:
             raise ValueError(
                 f"pipeline_stages={self.pipeline_stages} must divide "
                 f"num_layers={self.num_layers} evenly"
+            )
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pipeline_schedule must be 'gpipe' or '1f1b', got "
+                f"{self.pipeline_schedule!r}"
+            )
+        if self.pipeline_schedule == "1f1b" and self.dropout_rate > 0:
+            raise NotImplementedError(
+                "the 1f1b manual backward does not thread dropout rngs "
+                "through the stage remat; use gpipe or dropout_rate=0"
             )
         if self.moe_num_experts == 1:
             raise ValueError("moe_num_experts must be 0 (dense) or >= 2")

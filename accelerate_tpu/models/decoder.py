@@ -42,6 +42,71 @@ def _dense_init(scale: float = 1.0):
     return nn.initializers.variance_scaling(scale, "fan_in", "normal")
 
 
+def _embed_lookup(embedding, input_ids, cfg, mesh):
+    """Token embedding, shared by ``__call__`` and the 1f1b builder so the
+    two schedules can never drift. With a sharded mesh, ``take`` lowers to a
+    gather the SPMD partitioner can only reshard by full rematerialization
+    (replicate-then-repartition — the round-1 dryrun warning). The one-hot
+    matmul form partitions cleanly: vocab-sharded embedding x one-hot
+    contracts over vocab with a psum, every other axis propagates, and the
+    MXU eats the matmul."""
+    if mesh is not None and any(
+        mesh.shape.get(a, 1) > 1 for a in ("tensor", "fsdp", "sequence", "stage")
+    ):
+        one_hot = jax.nn.one_hot(input_ids, cfg.vocab_size, dtype=cfg.dtype)
+        x = one_hot @ embedding.astype(cfg.dtype)
+    else:
+        x = jnp.take(embedding, input_ids, axis=0).astype(cfg.dtype)
+    return _constrain(x, ("batch", "seq", "embed"), mesh)
+
+
+def _tied_vocab_kernel(embedding, lm_head, cfg):
+    """[E, V] LM-head kernel (the transpose of the embedding when tied)."""
+    if cfg.tie_embeddings:
+        return embedding.T.astype(cfg.dtype)
+    return lm_head.astype(cfg.dtype)
+
+
+def _head_ce_loss(x, ln_f, embedding, lm_head, labels, cfg, mesh, weight=None):
+    """Final-norm + LM-head + fused CE, shared by ``__call__``'s labels path
+    and the 1f1b builder. HF convention: labels == input_ids, shifted
+    internally so position i predicts token i+1; mean over non-ignored
+    tokens. ``weight`` rescales the mean (the 1f1b schedule passes each
+    microbatch's valid-token share so the sum over microbatches equals the
+    GLOBAL token mean even with uneven -100 padding)."""
+    x = rms_norm(x, ln_f, cfg.norm_eps)
+    x = _constrain(x, ("batch", "seq", "embed"), mesh)
+    vocab_kernel = _tied_vocab_kernel(embedding, lm_head, cfg)
+    b, s = x.shape[0], x.shape[1]
+    hidden = x[:, :-1].reshape(b * (s - 1), cfg.embed_dim)
+    targets = labels[:, 1:].reshape(b * (s - 1))
+    loss = fused_linear_cross_entropy(
+        hidden, vocab_kernel, targets,
+        ignore_index=-100, num_chunks=cfg.fused_ce_chunks,
+    )
+    return loss if weight is None else loss * weight
+
+
+def _adapt_microbatches(b: int, configured: int, num_stages: int) -> int:
+    """Largest M <= configured dividing batch b. M only affects the schedule
+    (params are per-stage, not per-M), so odd batches (init's batch_size=1,
+    ragged eval) still trace; warn when degrading a real batch."""
+    m = configured
+    while b % m != 0:
+        m -= 1
+    if m != configured and b > 1:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "pipeline: batch %d is not divisible by the configured "
+            "%d microbatches; running with M=%d — at M < num_stages "
+            "(%d) the pipeline bubble dominates. Pick a batch size "
+            "divisible by pipeline_microbatches.",
+            b, configured, m, num_stages,
+        )
+    return m
+
+
 def _stream_params_to_device(tree):
     """In-graph host->HBM transfer of a param subtree. Inside a scan body
     this runs on the per-layer *slice*, so only the live layer's weights
@@ -268,20 +333,7 @@ class DecoderLM(nn.Module):
             nn.with_logical_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab_size, cfg.embed_dim),
         )
-        # Embedding lookup. With a sharded mesh, `take` lowers to a gather
-        # the SPMD partitioner can only reshard by full rematerialization
-        # (replicate-then-repartition — the round-1 dryrun warning). The
-        # one-hot matmul form partitions cleanly: vocab-sharded embedding x
-        # one-hot contracts over vocab with a psum, every other axis
-        # propagates, and the MXU eats the matmul.
-        if self.mesh is not None and any(
-            self.mesh.shape.get(a, 1) > 1 for a in ("tensor", "fsdp", "sequence", "stage")
-        ):
-            one_hot = jax.nn.one_hot(input_ids, cfg.vocab_size, dtype=cfg.dtype)
-            x = one_hot @ embedding.astype(cfg.dtype)
-        else:
-            x = jnp.take(embedding, input_ids, axis=0).astype(cfg.dtype)
-        x = _constrain(x, ("batch", "seq", "embed"), self.mesh)
+        x = _embed_lookup(embedding, input_ids, cfg, self.mesh)
 
         if positions is None:
             positions = jnp.arange(s)
@@ -299,23 +351,9 @@ class DecoderLM(nn.Module):
 
             if cfg.pipeline_stages <= 1:
                 cfg = dataclasses.replace(cfg, pipeline_stages=num_stages)
-            num_micro = cfg.pipeline_microbatches or num_stages
-            # M only affects the schedule (params are per-stage, not per-M):
-            # adapt it down to the largest count dividing this batch so odd
-            # batches (init's batch_size=1, ragged eval) still trace.
-            configured_micro = num_micro
-            while b % num_micro != 0:
-                num_micro -= 1
-            if num_micro != configured_micro and b > 1:
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "pipeline: batch %d is not divisible by the configured "
-                    "%d microbatches; running with M=%d — at M < num_stages "
-                    "the GPipe bubble dominates. Pick a batch size divisible "
-                    "by pipeline_microbatches.",
-                    b, configured_micro, num_micro,
-                )
+            num_micro = _adapt_microbatches(
+                b, cfg.pipeline_microbatches or num_stages, num_stages
+            )
             x_mb = split_microbatches(x, num_micro)
             x = PipelineStages(
                 stage_module=StageStack,
@@ -356,37 +394,118 @@ class DecoderLM(nn.Module):
                 moe_aux = moe_aux + block_aux
 
         ln_f = self.param("ln_final", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
-        x = rms_norm(x, ln_f, cfg.norm_eps)
-
-        if cfg.tie_embeddings:
-            vocab_kernel = embedding.T.astype(cfg.dtype)
-        else:
-            vocab_kernel = self.param(
+        lm_head = None
+        if not cfg.tie_embeddings:
+            lm_head = self.param(
                 "lm_head",
                 nn.with_logical_partitioning(_dense_init(), ("embed", "vocab")),
                 (cfg.embed_dim, cfg.vocab_size),
-            ).astype(cfg.dtype)
+            )
 
         if labels is not None:
-            # HF convention: labels == input_ids, shifted internally so
-            # position i predicts token i+1.
-            hidden = x[:, :-1].reshape(b * (s - 1), cfg.embed_dim)
-            targets = labels[:, 1:].reshape(b * (s - 1))
-            loss = fused_linear_cross_entropy(
-                hidden,
-                vocab_kernel,
-                targets,
-                ignore_index=-100,
-                num_chunks=cfg.fused_ce_chunks,
-            )
+            loss = _head_ce_loss(x, ln_f, embedding, lm_head, labels, cfg, self.mesh)
             if cfg.moe_num_experts > 1:
                 aux = cfg.moe_aux_loss_weight * moe_aux / cfg.num_layers
                 return {"loss": loss + aux, "lm_loss": loss, "aux_loss": aux}
             return {"loss": loss}
+        x = rms_norm(x, ln_f, cfg.norm_eps)
+        vocab_kernel = _tied_vocab_kernel(embedding, lm_head, cfg)
         out = {"logits": _constrain((x @ vocab_kernel).astype(jnp.float32), ("batch", "seq", "vocab"), self.mesh)}
         if cfg.moe_num_experts > 1:
             out["aux_loss"] = cfg.moe_aux_loss_weight * moe_aux / cfg.num_layers
         return out
+
+    def pipeline_value_and_grad(self):
+        """Manual ``(params, input_ids, labels) -> (loss, grads)`` for the
+        1F1B pipeline schedule (``config.pipeline_schedule == "1f1b"``).
+
+        Reverse-mode AD through the GPipe belt stashes O(M) microbatch
+        activations per stage; ``parallel/pipeline.one_f_one_b`` interleaves
+        each microbatch's backward into the same scan, bounding the stash at
+        O(S). This builder decomposes the model exactly as ``__call__``'s
+        pipeline path does — embedding in front, the stage-vmapped
+        ``StageStack`` in the middle, ``ln_final`` + (tied) LM head + fused
+        CE behind — computes the head/embedding grads with local ``jax.vjp``
+        and the stage grads with the scheduler. Each microbatch's mean CE is
+        weighted by its valid-token share, so the summed loss equals the
+        GLOBAL non-ignored-token mean ``__call__`` computes — gpipe and 1f1b
+        agree even with uneven -100 padding across microbatches. Returns
+        None when the schedule is not "1f1b" (the engine then uses plain
+        AD).
+        """
+        cfg = self.config
+        num_stages = self._effective_stages()
+        if cfg.pipeline_schedule != "1f1b" or num_stages <= 1:
+            return None
+        from ..parallel.pipeline import one_f_one_b, split_microbatches
+
+        mesh = self.mesh
+        if cfg.pipeline_stages > 1:
+            cfg_staged = cfg
+        else:
+            cfg_staged = dataclasses.replace(cfg, pipeline_stages=num_stages)
+
+        def value_and_grad(params, input_ids, labels):
+            b, s = input_ids.shape
+            M = _adapt_microbatches(
+                b, cfg_staged.pipeline_microbatches or num_stages, num_stages
+            )
+            positions = jnp.arange(s)
+            sin, cos = rotary_embedding_tables(
+                positions, cfg.head_dim, theta=cfg.rope_theta, dtype=cfg.dtype
+            )
+            stage_params = params["pipeline"]["schedule"]["stages"]
+            outer = {k: v for k, v in params.items() if k != "pipeline"}
+            labels_mb = split_microbatches(labels, M)
+            # per-microbatch valid-token share of the global mean (shifted
+            # labels: position i predicts token i+1, so column 0 never counts)
+            counts = jnp.sum(labels_mb[:, :, 1:] != -100, axis=(1, 2)).astype(jnp.float32)
+            weights = counts / jnp.maximum(jnp.sum(counts), 1.0)
+
+            def embed_fn(outer_p, ids):
+                x = _embed_lookup(outer_p["embedding"], ids, cfg, mesh)
+                return split_microbatches(x, M)
+
+            def stage_fn(p_s, x):
+                return StageStack(cfg_staged, mesh).apply(
+                    {"params": p_s}, x, sin, cos, True
+                )
+
+            def make_dy(m, y):
+                tgt = jax.lax.dynamic_index_in_dim(labels_mb, m, 0, keepdims=False)
+                w = jax.lax.dynamic_index_in_dim(weights, m, 0, keepdims=False)
+                loss_m, vjp = jax.vjp(
+                    lambda op, yy: _head_ce_loss(
+                        yy, op["ln_final"], op["embedding"], op.get("lm_head"),
+                        tgt, cfg, mesh, weight=w,
+                    ),
+                    outer, y,
+                )
+                douter_h, dy = vjp(jnp.ones((), loss_m.dtype))
+                # fp32 accumulators: the scheduler sums aux over M microbatches
+                douter_h = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), douter_h
+                )
+                return {"loss": loss_m.astype(jnp.float32), "douter": douter_h}, dy
+
+            x_mb = embed_fn(outer, input_ids)
+            aux, stage_grads, dx_mb = one_f_one_b(
+                stage_fn, stage_params, x_mb, make_dy,
+                num_stages=num_stages, num_microbatches=M, mesh=mesh,
+            )
+            # embedding backward: re-run the (cheap) embed under vjp and pull
+            # the pipeline-input cotangents through it
+            _, embed_vjp = jax.vjp(lambda op: embed_fn(op, input_ids), outer)
+            (douter_e,) = embed_vjp(dx_mb.astype(x_mb.dtype))
+            douter = jax.tree_util.tree_map(
+                lambda a, b_: a.astype(jnp.float32) + b_.astype(jnp.float32),
+                aux["douter"], douter_e,
+            )
+            grads = dict(douter)
+            grads["pipeline"] = {"schedule": {"stages": stage_grads}}
+            return aux["loss"], grads
+
+        return value_and_grad
 
     def host_streamable_prefixes(self) -> list:
         """Param-path prefixes this model streams host->HBM internally (the

@@ -137,6 +137,188 @@ class PipelineStages(nn.Module):
         return constrain_activation(x, self.outputs_logical_axes[1:], self.mesh)
 
 
+def one_f_one_b(
+    stage_fn,
+    stage_params,
+    x_mb: jax.Array,
+    make_dy,
+    *,
+    num_stages: int,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    buffer_logical_axes: tuple = ("stage", "batch", "seq", "embed"),
+):
+    """Pipelined value-and-grad with the 1F1B (PipeDream-flush) schedule,
+    lock-step SPMD form: every tick, each stage runs ONE forward on its
+    current microbatch AND one backward on an earlier microbatch.
+
+    Reverse-mode AD through the GPipe scan (PipelineStages) is structurally
+    all-forward-then-all-backward: the residual stash grows with the
+    schedule length, O(M) microbatch activations live per stage (reference
+    Megatron schedule analog: megatron_lm.py forward_backward funcs). Here
+    the backward is hand-scheduled inside the same scan, so a stage only
+    stashes inputs for its in-flight microbatches — at most 2(S-1)+1 slots,
+    **independent of M**. Longer accumulation (bigger M) amortizes the
+    pipeline bubble at constant activation memory, which is the whole point
+    of 1F1B.
+
+    Per tick, stage ``s`` forwards microbatch ``t - s`` and backwards
+    microbatch ``t - (2S-1-s)`` (both when in range). The backward
+    re-runs the stage forward from the stashed input under ``jax.vjp``
+    (rematerialization — the same FLOPs the remat'd GPipe backward pays).
+    Activations hand forward and cotangents hand backward as neighbor
+    collective-permutes over the "stage" mesh axis, lowered by GSPMD from
+    the two concatenate-shifts.
+
+    Args:
+      stage_fn: ``(params_one_stage, x) -> y`` with ``y.shape == x.shape``
+        (one pipeline stage, NOT stage-vmapped; closures carry consts).
+        Must be deterministic — dropout inside stages is not supported by
+        the manual backward (the flagship decoder trains with
+        dropout_rate=0).
+      stage_params: pytree with leading stage dim ``S`` on every leaf.
+      x_mb: ``[M, mb, ...]`` microbatched pipeline inputs (see
+        ``split_microbatches``).
+      make_dy: ``(m, y) -> (aux, dy)`` — for the last-stage output ``y`` of
+        microbatch ``m`` (clamped to [0, M)), returns an aux pytree
+        (accumulated by summation over valid microbatches; put per-mb loss
+        and tail-parameter grads here) and the cotangent ``dy`` of ``y``
+        **including the caller's microbatch weighting** (e.g. 1/M for a
+        mean-of-microbatch-means loss).
+
+    Returns ``(aux_sum, stage_grads, dx_mb)``: the summed aux tree, grads
+    for ``stage_params`` (same structure, fp32), and the cotangent wrt
+    ``x_mb``.
+    """
+    from .sharding import constrain_activation
+
+    S, M = num_stages, num_microbatches
+    steps = M + 2 * S - 1
+    # stash ring: stage s's read lags its write by 2S-1-2s ticks, so 2S-1
+    # slots suffice (the tick reads before it writes); >=2 keeps the S=1
+    # degenerate case from reading a slot written the same tick
+    K = max(2, 2 * S - 1)
+
+    def _cb(buf):  # [S, mb...]
+        return constrain_activation(buf, buffer_logical_axes, mesh)
+
+    def _cs(x):  # [mb...]
+        return constrain_activation(x, buffer_logical_axes[1:], mesh)
+
+    def _cstash(st):  # [S, K, mb...]
+        names = (buffer_logical_axes[0], None) + buffer_logical_axes[1:]
+        return constrain_activation(st, names, mesh)
+
+    def _cx(xm):  # [M, mb...]
+        return constrain_activation(xm, (None,) + buffer_logical_axes[1:], mesh)
+
+    stage_fwd = jax.vmap(stage_fn)
+
+    def stage_bwd(p, x, ct):
+        _, vjp = jax.vjp(stage_fn, p, x)
+        return vjp(ct)
+
+    stage_bwd = jax.vmap(stage_bwd)
+
+    mb_struct = jax.eval_shape(lambda x: x[0], x_mb)
+    aux_struct, dy_struct = jax.eval_shape(
+        make_dy, jax.ShapeDtypeStruct((), jnp.int32), mb_struct
+    )
+
+    def tick(carry, t):
+        buffer, cot, stash, grads, aux, dx_mb = carry
+
+        # ---- stash read FIRST: backward inputs for microbatch t-(2S-1-s)
+        # at stage s, stashed at tick b+s = t-(2S-1)+2s. For stage 0 that
+        # read lags the write by exactly K ticks, so the read must happen
+        # before this tick's write lands in the same ring slot.
+        read_idx = (t - (2 * S - 1) + 2 * jnp.arange(S)) % K
+        x_b = jax.vmap(
+            lambda st, i: jax.lax.dynamic_index_in_dim(st, i, 0, keepdims=False)
+        )(stash, read_idx)
+
+        # ---- stash write + forward ----
+        stash = jax.vmap(
+            lambda st, v: jax.lax.dynamic_update_index_in_dim(st, v, t % K, 0)
+        )(stash, buffer)
+        stash = _cstash(stash)
+        y = _cb(stage_fwd(stage_params, buffer))
+
+        # last stage just finished microbatch t-(S-1): loss + fresh cotangent
+        # (re-constrain the slice so the head computes on the microbatch's
+        # natural batch sharding instead of a remnant of the stage layout).
+        # lax.cond, not a mask: make_dy is the full LM-head fwd+vjp (a
+        # vocab-sized matmul pair) and 2S-1 of the M+2S-1 ticks are
+        # fill/drain whose head result would be discarded — cond skips the
+        # FLOPs instead of zeroing them.
+        m_y = t - (S - 1)
+        fwd_done = jnp.logical_and(m_y >= 0, m_y < M)
+        aux_t, dy_t = jax.lax.cond(
+            fwd_done,
+            lambda yy: make_dy(jnp.clip(m_y, 0, M - 1), yy),
+            lambda yy: (
+                jax.tree_util.tree_map(
+                    lambda sd: jnp.zeros(sd.shape, sd.dtype), aux_struct
+                ),
+                jnp.zeros(dy_struct.shape, dy_struct.dtype),
+            ),
+            _cs(y[-1]),
+        )
+        aux = jax.tree_util.tree_map(
+            lambda a, v: a + v.astype(a.dtype), aux, aux_t
+        )
+
+        # ---- backward: remat each stage's forward from the stashed input ----
+        dp, dx = stage_bwd(stage_params, _cb(x_b), cot)
+        b_idx = t - (2 * S - 1 - jnp.arange(S))
+        bwd_valid = jnp.logical_and(b_idx >= 0, b_idx < M)
+
+        def _acc(g, d):
+            mask = bwd_valid.reshape((S,) + (1,) * (d.ndim - 1))
+            return g + jnp.where(mask, d, 0).astype(jnp.float32)
+
+        grads = jax.tree_util.tree_map(_acc, grads, dp)
+
+        # stage 0's dx is the cotangent wrt pipeline input of mb t-(2S-1)
+        b0 = t - (2 * S - 1)
+        b0c = jnp.clip(b0, 0, M - 1)
+        cur = jax.lax.dynamic_index_in_dim(dx_mb, b0c, 0, keepdims=False)
+        slot = _cs(jnp.where(b0 >= 0, dx[0], cur))
+        dx_mb = _cx(jax.lax.dynamic_update_index_in_dim(dx_mb, slot, b0c, 0))
+
+        # ---- advance both belts (neighbor collective-permutes) ----
+        nxt = jnp.clip(t + 1, 0, M - 1)
+        feed = jax.lax.dynamic_index_in_dim(x_mb, nxt, 0, keepdims=False)
+        feed = _cs(jnp.where(t + 1 < M, feed, jnp.zeros_like(feed)))
+        buffer = _cb(jnp.concatenate([feed[None], y[:-1]], axis=0))
+        # cotangents flow last->first: stage s receives stage s+1's dx for
+        # the microbatch it backwards next tick; the fresh last-stage slot
+        # is this tick's loss cotangent (mb t-(S-1), backwarded at t+1)
+        cot = _cb(jnp.concatenate([dx[1:], dy_t[None]], axis=0))
+        return (buffer, cot, stash, grads, aux, dx_mb), None
+
+    mb_shape = x_mb.shape[1:]
+    buffer0 = _cb(
+        jnp.concatenate(
+            [x_mb[:1], jnp.zeros((S - 1,) + mb_shape, x_mb.dtype)], axis=0
+        )
+    )
+    cot0 = _cb(jnp.zeros((S,) + mb_shape, x_mb.dtype))
+    stash0 = _cstash(jnp.zeros((S, K) + mb_shape, x_mb.dtype))
+    grads0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), stage_params
+    )
+    aux0 = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), aux_struct
+    )
+    dx0 = _cx(jnp.zeros_like(x_mb))
+
+    (_, _, _, grads, aux, dx_mb), _ = jax.lax.scan(
+        tick, (buffer0, cot0, stash0, grads0, aux0, dx0), jnp.arange(steps)
+    )
+    return aux, grads, dx_mb
+
+
 def split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
     """[B, ...] -> [M, B/M, ...], microbatch m = rows {m, m+M, m+2M, ...}.
 

@@ -315,6 +315,64 @@ def _decode_bench(cfg, prompt_len, base_tokens=16, extra_tokens=256):
     return float(np.median(timings))
 
 
+def _pipeline_mem_worker():
+    """Compiled temp-memory (stash + belts) for gpipe-under-AD vs the manual
+    1F1B schedule at M=4S, on the 8-device CPU sim (the schedule's win is a
+    memory asymptotic — O(S) vs O(M) per-stage activation stash — which is
+    measurable without stage hardware). Prints one JSON line."""
+    import dataclasses
+
+    from accelerate_tpu.models import DecoderConfig, DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+
+    M = 32
+    cfg = DecoderConfig(
+        vocab_size=256, num_layers=4, embed_dim=128, num_heads=4,
+        max_seq_len=256, dtype=jnp.float32, remat=True, scan_layers=True,
+        pipeline_stages=4, pipeline_microbatches=M,
+    )
+    model = DecoderLM(cfg)
+    ids = jnp.zeros((M * 2, 256), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids[:1])
+    params, _ = unbox_params(variables["params"])
+
+    def gpipe_vag(p, i, l):
+        return jax.value_and_grad(
+            lambda pp: model.apply({"params": pp}, i, labels=l)["loss"]
+        )(p)
+
+    vag = DecoderLM(
+        dataclasses.replace(cfg, pipeline_schedule="1f1b")
+    ).pipeline_value_and_grad()
+    out = {}
+    for name, fn in (("gpipe", gpipe_vag), ("1f1b", vag)):
+        ma = jax.jit(fn).lower(params, ids, ids).compile().memory_analysis()
+        out[name] = ma.temp_size_in_bytes
+    print(json.dumps(out))
+
+
+def _pipeline_mem_bench() -> dict:
+    """Run _pipeline_mem_worker in a CPU-sim subprocess (the bench process
+    owns the TPU backend; the memory comparison neither needs nor should
+    occupy it)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_pipeline_mem"],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        line = res.stdout.strip().splitlines()[-1]
+        return json.loads(line)
+    except Exception:
+        return {}
+
+
 def main():
     import argparse
 
@@ -327,7 +385,16 @@ def main():
                         help="internal: run one TTFT attempt and print it")
     parser.add_argument("--_ttft_int8", action="store_true",
                         help="internal: quantize-on-load for the TTFT attempt")
+    parser.add_argument("--_pipeline_mem", action="store_true",
+                        help="internal: print gpipe-vs-1f1b compiled temp bytes")
     args, _ = parser.parse_known_args()
+
+    if args._pipeline_mem:
+        # env JAX_PLATFORMS is not enough: the axon sitecustomize
+        # force-registers the TPU platform at interpreter start
+        jax.config.update("jax_platforms", "cpu")
+        _pipeline_mem_worker()
+        return
 
     on_tpu = jax.default_backend() == "tpu"
 
@@ -414,6 +481,11 @@ def main():
         extra["dispatch_ttft_int8_best_s"] = round(min(tries_q), 2)
         extra["dispatch_ttft_int8_attempts"] = [round(t, 2) for t in tries_q]
         extra["decode_ms_per_token"] = round(_decode_bench(ttft_cfg, 128) * 1e3, 2)
+
+        mem = _pipeline_mem_bench()
+        if mem:
+            extra["pipeline_gpipe_temp_mb"] = round(mem["gpipe"] / 1e6, 1)
+            extra["pipeline_1f1b_temp_mb"] = round(mem["1f1b"] / 1e6, 1)
     else:
         cfg = DecoderConfig.tiny(max_seq_len=256)
         tok_s, mfu, _, step_ms = _train_bench(cfg, 4, 128, 5, "no")
