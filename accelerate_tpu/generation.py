@@ -346,3 +346,107 @@ def generate_dispatched(dispatched, input_ids, **kwargs):
         dispatched.definition, params, input_ids,
         param_placer=dispatched.param_placer(), **kwargs
     )
+
+
+def _seq2seq_prefill_for(definition, temperature, top_k):
+    key = ("s2s_prefill", id(definition), temperature, top_k)
+    if key in _LOOP_CACHE:
+        return _LOOP_CACHE[key]
+
+    @jax.jit
+    def prefill(params, input_ids, attention_mask, start_ids, rng):
+        enc = definition.apply({"params": params}, input_ids, attention_mask,
+                               method="encode")
+        logits, mutated = definition.apply(
+            {"params": params},
+            start_ids,
+            encoder_states=enc,
+            attention_mask=attention_mask,
+            use_cache=True,
+            mutable=["cache"],
+            method="decode",
+        )
+        last = _sample(logits[:, -1], rng, temperature, top_k)
+        return last, mutated["cache"]
+
+    return _cache_put(key, prefill)
+
+
+def _seq2seq_loop_for(definition, max_new_tokens, temperature, top_k):
+    key = ("s2s_loop", id(definition), max_new_tokens, temperature, top_k)
+    if key in _LOOP_CACHE:
+        return _LOOP_CACHE[key]
+
+    @jax.jit
+    def loop(params, cache, last_token, start_pos, rng):
+        def step(carry, _):
+            cache, tok, pos, rng = carry
+            rng, sub = jax.random.split(rng)
+            # encoder K/V were frozen in the cache at prefill: no
+            # encoder_states needed, each step pays only the one-token
+            # self-attn append + cross-attn read
+            logits, mutated = definition.apply(
+                {"params": params, "cache": cache},
+                tok[:, None],
+                positions=pos[None],
+                use_cache=True,
+                decode_step=True,
+                mutable=["cache"],
+                method="decode",
+            )
+            nxt = _sample(logits[:, -1], sub, temperature, top_k)
+            return (mutated["cache"], nxt, pos + 1, rng), nxt
+
+        (cache, _, _, _), tokens = jax.lax.scan(
+            step, (cache, last_token, start_pos, rng), None, length=max_new_tokens
+        )
+        return tokens.T
+
+    return _cache_put(key, loop)
+
+
+def generate_seq2seq(
+    definition,
+    params,
+    input_ids,
+    *,
+    max_new_tokens: int = 32,
+    attention_mask=None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+):
+    """Encoder-decoder generation (models/seq2seq.Seq2SeqLM): encode the
+    source once, then a single jitted ``lax.scan`` emits target tokens
+    against the self-attn KV cache + the frozen cross-attn encoder K/V
+    (reference T5 generation capability, megatron_lm.py:840-877).
+    Returns [B, max_new_tokens] generated ids (without the start token)."""
+    from .utils.compile_cache import ensure_persistent_compile_cache
+
+    ensure_persistent_compile_cache()
+    input_ids = jnp.asarray(input_ids)
+    b = input_ids.shape[0]
+    cfg = definition.config
+    if input_ids.shape[1] > cfg.max_seq_len:
+        raise ValueError(
+            f"source length {input_ids.shape[1]} exceeds config.max_seq_len={cfg.max_seq_len}"
+        )
+    cap = cfg.max_cache_len or cfg.max_target_len
+    # slots written: the start token at prefill + max_new_tokens-1 decode
+    # appends (the final sampled token is returned, never fed back)
+    if max_new_tokens > cap:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds the decoder KV "
+            f"cache capacity ({cap}); raise config.max_cache_len"
+        )
+    if attention_mask is not None:
+        attention_mask = jnp.asarray(attention_mask)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    prefill_rng, decode_rng = jax.random.split(rng)
+
+    start_ids = jnp.full((b, 1), cfg.decoder_start_token_id, jnp.int32)
+    prefill = _seq2seq_prefill_for(definition, temperature, top_k)
+    last, cache = prefill(params, input_ids, attention_mask, start_ids, prefill_rng)
+    loop = _seq2seq_loop_for(definition, max_new_tokens - 1, temperature, top_k)
+    tokens = loop(params, cache, last, jnp.asarray(1, jnp.int32), decode_rng)
+    return jnp.concatenate([last[:, None], tokens], axis=1)

@@ -13,20 +13,26 @@ so we ship first-class implementations:
   mesh "expert" axis (enabled via ``DecoderConfig.moe_num_experts``).
 - ``ResNet`` — ResNet-family image classifier
   (reference `examples/cv_example.py` target, BASELINE.md).
+- ``Seq2SeqLM`` — T5-family encoder-decoder with flash cross-attention
+  and cached seq2seq generation (reference `utils/megatron_lm.py`
+  T5TrainStep target).
 """
 
 from .configs import DecoderConfig, EncoderConfig, VisionConfig
 from .decoder import DecoderLM
 from .encoder import EncoderClassifier
 from .moe import MoeMLP
+from .seq2seq import Seq2SeqConfig, Seq2SeqLM
 from .vision import ResNet
 
 __all__ = [
     "DecoderConfig",
     "EncoderConfig",
     "VisionConfig",
+    "Seq2SeqConfig",
     "DecoderLM",
     "EncoderClassifier",
     "MoeMLP",
     "ResNet",
+    "Seq2SeqLM",
 ]

@@ -437,6 +437,12 @@ class DecoderLM(nn.Module):
         num_stages = self._effective_stages()
         if cfg.pipeline_schedule != "1f1b" or num_stages <= 1:
             return None
+        if cfg.moe_num_experts > 1:
+            # MoE pipeline models return {"loss","lm_loss","aux_loss"}; the
+            # manual path's bare {"loss"} would break that contract — fall
+            # back to AD (mesh-auto-enabled pipelines reach here; explicit
+            # pipeline_stages>1 + MoE is already rejected at config time)
+            return None
         from ..parallel.pipeline import one_f_one_b, split_microbatches
 
         mesh = self.mesh

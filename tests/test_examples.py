@@ -58,6 +58,11 @@ class TestExamplesRun:
         assert r.returncode == 0, r.stderr
         assert "accuracy" in r.stdout
 
+    def test_seq2seq_example(self):
+        r = _run_example("seq2seq_example.py")
+        assert r.returncode == 0, r.stderr
+        assert "reversal_accuracy" in r.stdout
+
     def test_gradient_accumulation_example(self):
         r = _run_example(os.path.join("by_feature", "gradient_accumulation.py"),
                          "--gradient_accumulation_steps", "2")

@@ -1,0 +1,181 @@
+"""Encoder-decoder (T5-family) model: training numerics, cross-attention
+masking, cached generation parity, and mesh integration — reference
+capability analog: utils/megatron_lm.py T5TrainStep (720-877)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.models import Seq2SeqConfig, Seq2SeqLM
+from accelerate_tpu.models.seq2seq import shift_right
+from accelerate_tpu.parallel.sharding import unbox_params
+
+
+def _model_and_params(rng_seed=0, **kw):
+    cfg = Seq2SeqConfig.tiny(**kw)
+    model = Seq2SeqLM(cfg)
+    v = model.init_variables(jax.random.PRNGKey(rng_seed), batch_size=2, seq_len=16, target_len=12)
+    params, _ = unbox_params(v["params"])
+    return model, cfg, params
+
+
+class TestShiftRight:
+    def test_prepends_start_and_drops_last(self):
+        labels = jnp.asarray([[5, 6, 7], [8, 9, 10]])
+        out = shift_right(labels, 0)
+        np.testing.assert_array_equal(out, [[0, 5, 6], [0, 8, 9]])
+
+    def test_ignore_markers_become_start_id(self):
+        labels = jnp.asarray([[5, -100, 7]])
+        out = shift_right(labels, 0)
+        np.testing.assert_array_equal(out, [[0, 5, 0]])
+
+
+class TestSeq2SeqTraining:
+    def test_loss_matches_explicit_decoder_inputs(self):
+        model, cfg, params = _model_and_params()
+        rng = np.random.RandomState(1)
+        src = jnp.asarray(rng.randint(3, cfg.vocab_size, (2, 16)), jnp.int32)
+        tgt = jnp.asarray(rng.randint(3, cfg.vocab_size, (2, 12)), jnp.int32)
+        auto = model.apply({"params": params}, src, labels=tgt)["loss"]
+        explicit = model.apply(
+            {"params": params}, src,
+            decoder_input_ids=shift_right(tgt, cfg.decoder_start_token_id),
+            labels=tgt,
+        )["loss"]
+        np.testing.assert_allclose(float(auto), float(explicit), rtol=1e-6)
+
+    def test_loss_equals_logits_ce(self):
+        """The fused-CE training path must equal CE over decode() logits."""
+        model, cfg, params = _model_and_params()
+        rng = np.random.RandomState(2)
+        src = jnp.asarray(rng.randint(3, cfg.vocab_size, (2, 16)), jnp.int32)
+        tgt = jnp.asarray(rng.randint(3, cfg.vocab_size, (2, 12)), jnp.int32)
+        loss = model.apply({"params": params}, src, labels=tgt)["loss"]
+        logits = model.apply(
+            {"params": params}, src,
+            decoder_input_ids=shift_right(tgt, cfg.decoder_start_token_id),
+        )["logits"]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        ref = jnp.mean(lse - picked)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+    def test_encoder_padding_mask_blocks_attention(self):
+        """Changing tokens under the padding mask must not change the loss
+        (both encoder self-attn and decoder cross-attn mask them)."""
+        model, cfg, params = _model_and_params()
+        rng = np.random.RandomState(3)
+        src = np.asarray(rng.randint(3, cfg.vocab_size, (2, 16)), np.int32)
+        tgt = jnp.asarray(rng.randint(3, cfg.vocab_size, (2, 12)), jnp.int32)
+        mask = np.ones((2, 16), np.int32)
+        mask[:, 10:] = 0
+        l1 = model.apply({"params": params}, jnp.asarray(src), labels=tgt,
+                         attention_mask=jnp.asarray(mask))["loss"]
+        src2 = src.copy()
+        src2[:, 10:] = rng.randint(3, cfg.vocab_size, (2, 6))
+        l2 = model.apply({"params": params}, jnp.asarray(src2), labels=tgt,
+                         attention_mask=jnp.asarray(mask))["loss"]
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+    def test_echo_task_trains_through_cross_attention(self):
+        """The target (first source token, repeated) is ONLY predictable
+        through cross-attention — the unigram distribution over targets is
+        uniform, so beating ln(vocab_range) proves source information flows
+        encoder -> cross-attn -> logits."""
+        import optax
+
+        model, cfg, params = _model_and_params()
+        rng = np.random.RandomState(4)
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, src, tgt):
+            def loss_fn(p):
+                return model.apply({"params": p}, src, labels=tgt)["loss"]
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        losses = []
+        for i in range(80):
+            src = jnp.asarray(rng.randint(3, 35, (8, 8)), jnp.int32)
+            tgt = jnp.tile(src[:, :1], (1, 4))
+            params, opt_state, loss = step(params, opt_state, src, tgt)
+            losses.append(float(loss))
+        # unigram floor is ln(32) ~ 3.47; beating it decisively proves
+        # source information flows through cross-attention
+        assert losses[-1] < 2.0, (losses[0], losses[-1])
+
+
+class TestSeq2SeqGeneration:
+    def test_cached_matches_uncached_greedy(self):
+        from accelerate_tpu.generation import generate_seq2seq
+
+        model, cfg, params = _model_and_params(max_cache_len=16)
+        rng = np.random.RandomState(5)
+        src = jnp.asarray(rng.randint(3, cfg.vocab_size, (2, 16)), jnp.int32)
+        mask = jnp.asarray(
+            (np.arange(16)[None, :] < np.array([16, 10])[:, None]).astype(np.int32)
+        )
+        toks = generate_seq2seq(model, params, src, max_new_tokens=6, attention_mask=mask)
+        assert toks.shape == (2, 6)
+
+        enc = model.apply({"params": params}, src, mask, method="encode")
+        dec_in = jnp.full((2, 1), cfg.decoder_start_token_id, jnp.int32)
+        ref = []
+        for _ in range(6):
+            logits = model.apply({"params": params}, dec_in, encoder_states=enc,
+                                 attention_mask=mask, method="decode")
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            ref.append(nxt)
+            dec_in = jnp.concatenate([dec_in, nxt[:, None].astype(jnp.int32)], axis=1)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(jnp.stack(ref, axis=1)))
+
+    def test_capacity_check(self):
+        from accelerate_tpu.generation import generate_seq2seq
+
+        model, cfg, params = _model_and_params(max_cache_len=4)
+        src = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="cache"):
+            generate_seq2seq(model, params, src, max_new_tokens=8)
+
+
+class TestSeq2SeqMesh:
+    @pytest.mark.slow
+    def test_trains_on_tp_fsdp_mesh(self):
+        """Full engine path on a tensor x fsdp x data mesh: the logical axis
+        names line up with the shared rules, loss finite and decreasing."""
+        import optax
+
+        from accelerate_tpu import Accelerator, Model
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+        from accelerate_tpu.utils.dataclasses import ShardingConfig, ShardingStrategy
+
+        AcceleratorState._reset_state()
+        PartialState._reset_state()
+        GradientState._reset_state()
+        sc = ShardingConfig(
+            strategy=ShardingStrategy.FSDP,
+            tensor_parallel=2, fsdp=2, data_parallel=2,
+        )
+        acc = Accelerator(mixed_precision="bf16", sharding_config=sc)
+        cfg = Seq2SeqConfig.tiny(embed_dim=128, num_heads=8, mlp_dim=256)
+        model_def = Seq2SeqLM(cfg, mesh=acc.mesh)
+        variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=8,
+                                             seq_len=16, target_len=16)
+        model, opt = acc.prepare(Model(model_def, variables), optax.adamw(1e-3))
+        rng = np.random.RandomState(6)
+        src = rng.randint(3, cfg.vocab_size, (8, 16))
+        batch = acc.prepare_for_eval({"input_ids": src, "labels": src})
+
+        def loss_fn(apply_fn, params, batch):
+            return apply_fn(params, batch["input_ids"], labels=batch["labels"])["loss"]
+
+        step = acc.build_train_step(loss_fn=loss_fn)
+        losses = [float(jax.device_get(step(batch)["loss"])) for _ in range(3)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
