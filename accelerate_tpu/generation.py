@@ -348,13 +348,14 @@ def generate_dispatched(dispatched, input_ids, **kwargs):
     )
 
 
-def _seq2seq_prefill_for(definition, temperature, top_k):
-    key = ("s2s_prefill", id(definition), temperature, top_k)
+def _seq2seq_prefill_for(definition, temperature, top_k, placer):
+    key = ("s2s_prefill", id(definition), temperature, top_k, id(placer))
     if key in _LOOP_CACHE:
         return _LOOP_CACHE[key]
 
     @jax.jit
     def prefill(params, input_ids, attention_mask, start_ids, rng):
+        params = placer(params)
         enc = definition.apply({"params": params}, input_ids, attention_mask,
                                method="encode")
         logits, mutated = definition.apply(
@@ -372,8 +373,8 @@ def _seq2seq_prefill_for(definition, temperature, top_k):
     return _cache_put(key, prefill)
 
 
-def _seq2seq_loop_for(definition, max_new_tokens, temperature, top_k):
-    key = ("s2s_loop", id(definition), max_new_tokens, temperature, top_k)
+def _seq2seq_loop_for(definition, max_new_tokens, temperature, top_k, placer):
+    key = ("s2s_loop", id(definition), max_new_tokens, temperature, top_k, id(placer))
     if key in _LOOP_CACHE:
         return _LOOP_CACHE[key]
 
@@ -382,11 +383,12 @@ def _seq2seq_loop_for(definition, max_new_tokens, temperature, top_k):
         def step(carry, _):
             cache, tok, pos, rng = carry
             rng, sub = jax.random.split(rng)
+            p = placer(params)
             # encoder K/V were frozen in the cache at prefill: no
             # encoder_states needed, each step pays only the one-token
             # self-attn append + cross-attn read
             logits, mutated = definition.apply(
-                {"params": params, "cache": cache},
+                {"params": p, "cache": cache},
                 tok[:, None],
                 positions=pos[None],
                 use_cache=True,
@@ -415,15 +417,23 @@ def generate_seq2seq(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     rng: Optional[jax.Array] = None,
+    param_placer=None,
 ):
     """Encoder-decoder generation (models/seq2seq.Seq2SeqLM): encode the
     source once, then a single jitted ``lax.scan`` emits target tokens
     against the self-attn KV cache + the frozen cross-attn encoder K/V
     (reference T5 generation capability, megatron_lm.py:840-877).
-    Returns [B, max_new_tokens] generated ids (without the start token)."""
+    Returns [B, max_new_tokens] generated ids (without the start token).
+    ``param_placer`` is an in-graph transform applied to params inside the
+    jits (dispatch placement / dequantization); defaults to
+    dequantize-only, so QuantizedWeight trees work out of the box."""
     from .utils.compile_cache import ensure_persistent_compile_cache
 
     ensure_persistent_compile_cache()
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if param_placer is None:
+        from .utils.quantization import dequantize_params as param_placer  # noqa: F811
     input_ids = jnp.asarray(input_ids)
     b = input_ids.shape[0]
     cfg = definition.config
@@ -445,8 +455,19 @@ def generate_seq2seq(
     prefill_rng, decode_rng = jax.random.split(rng)
 
     start_ids = jnp.full((b, 1), cfg.decoder_start_token_id, jnp.int32)
-    prefill = _seq2seq_prefill_for(definition, temperature, top_k)
+    prefill = _seq2seq_prefill_for(definition, temperature, top_k, param_placer)
     last, cache = prefill(params, input_ids, attention_mask, start_ids, prefill_rng)
-    loop = _seq2seq_loop_for(definition, max_new_tokens - 1, temperature, top_k)
+    loop = _seq2seq_loop_for(definition, max_new_tokens - 1, temperature, top_k, param_placer)
     tokens = loop(params, cache, last, jnp.asarray(1, jnp.int32), decode_rng)
     return jnp.concatenate([last[:, None], tokens], axis=1)
+
+
+def generate_seq2seq_dispatched(dispatched, input_ids, **kwargs):
+    """generate_seq2seq() over a DispatchedModel wrapping a Seq2SeqLM: uses
+    its placed (possibly offloaded / quantized) params and its in-graph
+    placement transform — the seq2seq counterpart of generate_dispatched."""
+    params = dispatched._concrete(dispatched.params)
+    return generate_seq2seq(
+        dispatched.definition, params, input_ids,
+        param_placer=dispatched.param_placer(), **kwargs
+    )

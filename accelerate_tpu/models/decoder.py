@@ -139,15 +139,22 @@ class DecoderAttention(nn.Module):
     attends causally on the flash path; each decode step (decode=True, s==1)
     appends at the running index and attends against the cache prefix. The
     cache is [B, KVH, max_cache_len, D] — static shapes, so the whole decode
-    loop compiles once."""
+    loop compiles once.
+
+    ``causal=False`` (+ optional ``kv_mask``) is the bidirectional form the
+    seq2seq encoder reuses (models/seq2seq.py) — same projections, RoPE and
+    logical axes, no cache. Ring attention over a "sequence" mesh axis is
+    causal-only; masked/bidirectional inputs fall back to GSPMD-partitioned
+    flash attention."""
 
     config: DecoderConfig
     mesh: Optional[Mesh] = None
     use_cache: bool = False
     decode: bool = False
+    causal: bool = True
 
     @nn.compact
-    def __call__(self, x, sin, cos, deterministic: bool = True):
+    def __call__(self, x, sin, cos, deterministic: bool = True, kv_mask=None):
         cfg = self.config
         e, h, kv, d = cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
         b, s = x.shape[0], x.shape[1]
@@ -191,12 +198,19 @@ class DecoderAttention(nn.Module):
 
                 bias = jnp.where(kv_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF)[None, None]
                 out = dot_product_attention(q, k_full, v_full, causal=False, bias=bias)
-        elif self.mesh is not None and self.mesh.shape.get("sequence", 1) > 1:
+        elif (
+            self.causal
+            and kv_mask is None
+            and self.mesh is not None
+            and self.mesh.shape.get("sequence", 1) > 1
+        ):
             from ..parallel.context import ring_attention_sharded
 
             out = ring_attention_sharded(q, k, v, self.mesh, causal=True)
         else:
-            out = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+            out = dot_product_attention(
+                q, k, v, causal=self.causal, kv_mask=kv_mask, impl=cfg.attention_impl
+            )
         out = _constrain(out, ("batch", "heads", "seq", "head_dim"), self.mesh)
         out = jnp.einsum("bhsd,hde->bse", out, wo.astype(dt))
         return _constrain(out, ("batch", "seq", "embed"), self.mesh)

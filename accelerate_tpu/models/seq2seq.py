@@ -15,8 +15,12 @@ decoder — RMSNorm, SwiGLU, RoPE self-attention, GQA, pallas flash attention
   from the encoder output at prefill and frozen in the cache — decode steps
   pay one [1, E] x [E, KV*D] matmul less per layer.
 
-Every parameter carries the same logical axis names as DecoderLM, so every
-mesh strategy (dp/fsdp/tp/sp) applies unchanged. Both stacks roll into
+The attention/MLP blocks ARE the decoder's modules (DecoderAttention with
+``causal=False`` + kv_mask for the encoder, DecoderMLP incl. the fp8 path),
+so every parameter carries the same logical axis names and dp/fsdp/tp mesh
+strategies apply unchanged. A "sequence" axis shards activations too, but
+masked/bidirectional attention falls back to GSPMD-partitioned flash
+attention rather than the causal-only ring kernel. Both stacks roll into
 ``nn.scan`` (O(1) compile time in depth) with optional per-block remat.
 """
 
@@ -30,10 +34,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from ..ops.attention import NEG_INF, dot_product_attention
-from ..ops.layers import apply_rotary_embedding, rms_norm, rotary_embedding_tables, swiglu
+from ..ops.attention import dot_product_attention
+from ..ops.layers import rms_norm, rotary_embedding_tables
 from ..ops.losses import fused_linear_cross_entropy
 from .decoder import (
+    DecoderAttention,
+    DecoderMLP,
     _constrain,
     _dense_init,
     _embed_lookup,
@@ -68,10 +74,14 @@ class Seq2SeqConfig:
     scan_layers: bool = True
     fused_ce_chunks: int = 8
     max_cache_len: Optional[int] = None  # decode cache (None -> max_target_len)
+    # fp8 recipe on the MLP contractions (shared DecoderMLP, ops/fp8.py)
+    use_fp8: bool = False
 
     def __post_init__(self):
         if self.num_decoder_layers is None:
             self.num_decoder_layers = self.num_layers
+        if self.max_cache_len is None:
+            self.max_cache_len = self.max_target_len
         if self.num_kv_heads is None:
             self.num_kv_heads = self.num_heads
         if self.head_dim is None:
@@ -106,67 +116,6 @@ class Seq2SeqConfig:
         dec = self.num_decoder_layers * (self_attn + cross + mlp + 3 * e)
         head = 0 if self.tie_embeddings else e * v
         return v * e + enc + dec + 2 * e + head
-
-
-class _SelfAttention(nn.Module):
-    """Shared by both stacks: ``causal=False`` + ``kv_mask`` is the encoder
-    (bidirectional over padded inputs), ``causal=True`` (+ optional KV
-    cache) is the decoder. Same cache protocol as DecoderAttention
-    (decoder.py:136)."""
-
-    config: Seq2SeqConfig
-    mesh: Optional[Mesh] = None
-    causal: bool = True
-    use_cache: bool = False
-    decode: bool = False
-
-    @nn.compact
-    def __call__(self, x, sin, cos, kv_mask=None):
-        cfg = self.config
-        e, h, kv, d = cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-        b, s = x.shape[0], x.shape[1]
-        wq = self.param("wq", nn.with_logical_partitioning(_dense_init(), ("embed", "heads", "head_dim")), (e, h, d))
-        wk = self.param("wk", nn.with_logical_partitioning(_dense_init(), ("embed", "kv_heads", "head_dim")), (e, kv, d))
-        wv = self.param("wv", nn.with_logical_partitioning(_dense_init(), ("embed", "kv_heads", "head_dim")), (e, kv, d))
-        wo = self.param("wo", nn.with_logical_partitioning(_dense_init(), ("heads", "head_dim", "embed")), (h, d, e))
-
-        dt = cfg.dtype
-        q = jnp.einsum("bse,ehd->bhsd", x, wq.astype(dt))
-        k = jnp.einsum("bse,ehd->bhsd", x, wk.astype(dt))
-        v = jnp.einsum("bse,ehd->bhsd", x, wv.astype(dt))
-        q = _constrain(q, ("batch", "heads", "seq", "head_dim"), self.mesh)
-        k = _constrain(k, ("batch", "kv_heads", "seq", "head_dim"), self.mesh)
-        q = apply_rotary_embedding(q, sin, cos)
-        k = apply_rotary_embedding(k, sin, cos)
-
-        if self.use_cache:
-            max_len = cfg.max_cache_len or cfg.max_target_len
-            cached_k = self.variable("cache", "cached_key", jnp.zeros, (b, kv, max_len, d), k.dtype)
-            cached_v = self.variable("cache", "cached_value", jnp.zeros, (b, kv, max_len, d), v.dtype)
-            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
-            cur = cache_index.value
-            if not self.decode:
-                cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, 0, 0))
-                cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, 0, 0))
-                cache_index.value = jnp.asarray(s, jnp.int32)
-                out = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
-            else:
-                k_full = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
-                v_full = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
-                cached_k.value = k_full
-                cached_v.value = v_full
-                cache_index.value = cur + s
-                q_pos = cur + jnp.arange(s)
-                kv_pos = jnp.arange(max_len)
-                bias = jnp.where(kv_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF)[None, None]
-                out = dot_product_attention(q, k_full, v_full, causal=False, bias=bias)
-        else:
-            out = dot_product_attention(
-                q, k, v, causal=self.causal, kv_mask=kv_mask, impl=cfg.attention_impl
-            )
-        out = _constrain(out, ("batch", "heads", "seq", "head_dim"), self.mesh)
-        out = jnp.einsum("bhsd,hde->bse", out, wo.astype(dt))
-        return _constrain(out, ("batch", "seq", "embed"), self.mesh)
 
 
 class _CrossAttention(nn.Module):
@@ -231,22 +180,6 @@ class _CrossAttention(nn.Module):
         return _constrain(out, ("batch", "seq", "embed"), self.mesh)
 
 
-class _MLP(nn.Module):
-    config: Seq2SeqConfig
-    mesh: Optional[Mesh] = None
-
-    @nn.compact
-    def __call__(self, x):
-        cfg = self.config
-        e, m = cfg.embed_dim, cfg.mlp_dim
-        wg = self.param("w_gate", nn.with_logical_partitioning(_dense_init(), ("embed", "mlp")), (e, m))
-        wu = self.param("w_up", nn.with_logical_partitioning(_dense_init(), ("embed", "mlp")), (e, m))
-        wd = self.param("w_down", nn.with_logical_partitioning(_dense_init(), ("mlp", "embed")), (m, e))
-        dt = cfg.dtype
-        hidden = _constrain(swiglu(x @ wg.astype(dt), x @ wu.astype(dt)), ("batch", "seq", "mlp"), self.mesh)
-        return _constrain(hidden @ wd.astype(dt), ("batch", "seq", "embed"), self.mesh)
-
-
 class _EncoderBlock(nn.Module):
     config: Seq2SeqConfig
     mesh: Optional[Mesh] = None
@@ -256,13 +189,13 @@ class _EncoderBlock(nn.Module):
         cfg = self.config
         ln1 = self.param("ln_attn", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         ln2 = self.param("ln_mlp", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
-        y = _SelfAttention(cfg, self.mesh, causal=False, name="attn")(
-            rms_norm(x, ln1, cfg.norm_eps), sin, cos, kv_mask
+        y = DecoderAttention(cfg, self.mesh, causal=False, name="attn")(
+            rms_norm(x, ln1, cfg.norm_eps), sin, cos, deterministic, kv_mask=kv_mask
         )
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
         x = x + y
-        y = _MLP(cfg, self.mesh, name="mlp")(rms_norm(x, ln2, cfg.norm_eps))
+        y = DecoderMLP(cfg, self.mesh, name="mlp")(rms_norm(x, ln2, cfg.norm_eps))
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
         return x + y
@@ -280,9 +213,9 @@ class _DecoderBlock(nn.Module):
         ln1 = self.param("ln_self", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         ln2 = self.param("ln_cross", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         ln3 = self.param("ln_mlp", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
-        y = _SelfAttention(cfg, self.mesh, causal=True, use_cache=self.use_cache, decode=self.decode, name="self_attn")(
-            rms_norm(x, ln1, cfg.norm_eps), sin, cos
-        )
+        y = DecoderAttention(
+            cfg, self.mesh, use_cache=self.use_cache, decode=self.decode, name="self_attn"
+        )(rms_norm(x, ln1, cfg.norm_eps), sin, cos, deterministic)
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
         x = x + y
@@ -292,7 +225,7 @@ class _DecoderBlock(nn.Module):
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
         x = x + y
-        y = _MLP(cfg, self.mesh, name="mlp")(rms_norm(x, ln3, cfg.norm_eps))
+        y = DecoderMLP(cfg, self.mesh, name="mlp")(rms_norm(x, ln3, cfg.norm_eps))
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
         return x + y

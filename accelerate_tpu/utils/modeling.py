@@ -387,15 +387,19 @@ def load_checkpoint_in_model(
                 qw = quantize_array_host(
                     value, bits=quantization_config.bits,
                     group_size=quantization_config.group_size,
+                    qtype=quantization_config.quant_type,
+                    double_quant=quantization_config.double_quant,
                 )
                 if shardings is not None:
-                    # shardings were inferred on the packed shapes above, so
-                    # the data/scale children have their own entries
-                    qw = type(qw)(
-                        jax.device_put(jnp.asarray(qw.data), shardings[path + "/0"]),
-                        jax.device_put(jnp.asarray(qw.scale), shardings[path + "/1"]),
-                        qw.shape, qw.bits, qw.group, qw.dtype,
-                    )
+                    # shardings were inferred on the packed shapes above;
+                    # every child (data/scale, incl. nested QuantizedScale
+                    # under double quant) has its own "<path>/<child>" entry
+                    sub = flatten_pytree(qw)
+                    placed = {
+                        k: jax.device_put(jnp.asarray(v), shardings[f"{path}/{k}"])
+                        for k, v in sub.items()
+                    }
+                    qw = unflatten_to_like(placed, qw)
                 else:
                     qw = jax.tree_util.tree_map(jnp.asarray, qw)
                 out[path] = qw

@@ -14,6 +14,15 @@ bandwidth-bound decode path.
 
 Symmetric per-group quantization along the input (first) dim:
 scale_g = amax(group) / qmax, data = round(w / scale_g).
+
+4-bit supports two codebooks (reference bnb.py BnbQuantizationConfig
+``bnb_4bit_quant_type``): "linear" (uniform int4) and "nf4" — the QLoRA
+NormalFloat4 code whose 16 levels are the quantiles of a standard normal,
+information-optimal for the approximately-normal weight distributions of
+trained nets. With ``double_quant`` the per-group fp32 absmax scales are
+themselves quantized (int8 over 256-scale blocks around their mean —
+reference ``bnb_4bit_use_double_quant``), shaving the scale overhead from
+32 to ~8.5 bits per group.
 """
 
 from __future__ import annotations
@@ -25,24 +34,50 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# NormalFloat4 code (QLoRA, Dettmers et al. 2023): 16 asymmetric levels,
+# the quantiles of N(0,1) normalized to [-1, 1], with an exact zero
+NF4_CODE = np.array(
+    [
+        -1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+        -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+        0.07958029955625534, 0.16093020141124725, 0.24611230194568634,
+        0.33791524171829224, 0.4407098591327667, 0.5626170039176941,
+        0.7229568362236023, 1.0,
+    ],
+    np.float32,
+)
+_NF4_MIDPOINTS = (NF4_CODE[1:] + NF4_CODE[:-1]) / 2
+_DOUBLE_QUANT_BLOCK = 256  # scales per second-level absmax block (bnb default)
+
 
 @dataclass
 class QuantizationConfig:
     """reference BnbQuantizationConfig (utils/dataclasses.py). ``skip_modules``
     defaults to embedding/head-like params (quantizing tied embeddings hurts
-    accuracy disproportionately, same default as bnb's llm_int8_skip_modules)."""
+    accuracy disproportionately, same default as bnb's llm_int8_skip_modules).
+    ``quant_type`` ("linear"/"nf4") and ``double_quant`` mirror the
+    reference's bnb_4bit_quant_type / bnb_4bit_use_double_quant and apply to
+    4-bit only."""
 
     load_in_8bit: bool = False
     load_in_4bit: bool = False
     group_size: int = 128
     skip_modules: Optional[list] = None
     min_dims: int = 2  # only matrices quantize; norms/bias vectors never do
+    quant_type: str = "linear"
+    double_quant: bool = False
 
     def __post_init__(self):
         if self.load_in_8bit and self.load_in_4bit:
             raise ValueError("pick one of load_in_8bit / load_in_4bit")
         if not (self.load_in_8bit or self.load_in_4bit):
             raise ValueError("QuantizationConfig with neither 8bit nor 4bit enabled")
+        if self.quant_type not in ("linear", "nf4"):
+            raise ValueError(f"quant_type must be 'linear' or 'nf4', got {self.quant_type!r}")
+        if self.quant_type == "nf4" and not self.load_in_4bit:
+            raise ValueError("nf4 is a 4-bit code; set load_in_4bit=True")
+        if self.double_quant and not self.load_in_4bit:
+            raise ValueError("double_quant applies to 4-bit quantization only")
         if self.skip_modules is None:
             self.skip_modules = ["embedding", "lm_head", "embed", "classifier", "pooler"]
 
@@ -51,37 +86,67 @@ class QuantizationConfig:
         return 8 if self.load_in_8bit else 4
 
 
-class QuantizedWeight:
-    """Pytree node: ``data`` int8 ([K, N], int4 packed two-per-byte along K),
-    ``scale`` fp32 [K/group, N]. Static: shape, bits, group, dtype."""
+class QuantizedScale:
+    """Pytree node for double-quantized per-group scales: ``data`` int8
+    (the centered scales over ``_DOUBLE_QUANT_BLOCK``-sized flat blocks),
+    ``scale2`` fp32 per block, ``offset`` fp32 scalar (the mean removed
+    before the symmetric int8 quant). Static: the original scale shape."""
 
-    def __init__(self, data, scale, shape, bits, group, dtype):
+    def __init__(self, data, scale2, offset, shape):
+        self.data = data
+        self.scale2 = scale2
+        self.offset = offset
+        self.shape = tuple(shape)
+
+    def __repr__(self):
+        return f"QuantizedScale(shape={self.shape})"
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedScale,
+    lambda qs: ((qs.data, qs.scale2, qs.offset), (qs.shape,)),
+    lambda aux, ch: QuantizedScale(ch[0], ch[1], ch[2], aux[0]),
+)
+
+
+class QuantizedWeight:
+    """Pytree node: ``data`` int8 ([K, N]; 4-bit packs two values per byte
+    along K), ``scale`` fp32 [K/group, N] — or a nested ``QuantizedScale``
+    under double quantization. Static: shape, bits, group, dtype, qtype
+    ("linear" | "nf4")."""
+
+    def __init__(self, data, scale, shape, bits, group, dtype, qtype="linear"):
         self.data = data
         self.scale = scale
         self.shape = tuple(shape)
         self.bits = int(bits)
         self.group = int(group)
         self.dtype = dtype
+        self.qtype = qtype
 
     def __repr__(self):
-        return f"QuantizedWeight(shape={self.shape}, bits={self.bits}, group={self.group})"
+        return (
+            f"QuantizedWeight(shape={self.shape}, bits={self.bits}, "
+            f"group={self.group}, qtype={self.qtype})"
+        )
 
 
 def _qw_flatten(qw):
-    return (qw.data, qw.scale), (qw.shape, qw.bits, qw.group, qw.dtype)
+    return (qw.data, qw.scale), (qw.shape, qw.bits, qw.group, qw.dtype, qw.qtype)
 
 
 def _qw_unflatten(aux, children):
     data, scale = children
-    shape, bits, group, dtype = aux
-    return QuantizedWeight(data, scale, shape, bits, group, dtype)
+    shape, bits, group, dtype, qtype = aux
+    return QuantizedWeight(data, scale, shape, bits, group, dtype, qtype)
 
 
 jax.tree_util.register_pytree_node(QuantizedWeight, _qw_flatten, _qw_unflatten)
 
 
-def quantize_array(w, bits: int = 8, group_size: int = 128) -> QuantizedWeight:
-    """Symmetric per-group quantization of a [K, ...] float array along dim 0.
+def quantize_array(w, bits: int = 8, group_size: int = 128,
+                   qtype: str = "linear", double_quant: bool = False) -> QuantizedWeight:
+    """Per-group quantization of a [K, ...] float array along dim 0.
     One implementation (quantize_array_host) owns the math; concrete inputs
     quantize on the host and the packed result moves to device."""
     import jax.core
@@ -93,27 +158,39 @@ def quantize_array(w, bits: int = 8, group_size: int = 128) -> QuantizedWeight:
         )
     if isinstance(w, jax.Array):
         w = np.asarray(jax.device_get(w))
-    qw = quantize_array_host(np.asarray(w), bits=bits, group_size=group_size)
-    return QuantizedWeight(
-        jnp.asarray(qw.data), jnp.asarray(qw.scale), qw.shape, qw.bits, qw.group, qw.dtype
+    qw = quantize_array_host(
+        np.asarray(w), bits=bits, group_size=group_size,
+        qtype=qtype, double_quant=double_quant,
     )
+    return jax.tree_util.tree_map(jnp.asarray, qw)
 
 
-def quantize_array_host(w: np.ndarray, bits: int = 8, group_size: int = 128) -> QuantizedWeight:
+def quantize_array_host(
+    w: np.ndarray, bits: int = 8, group_size: int = 128,
+    qtype: str = "linear", double_quant: bool = False,
+) -> QuantizedWeight:
     """quantize_array in pure numpy — no device traffic. The load path uses
     this to quantize BEFORE the host->device transfer, so only the packed
-    int8/int4 bytes + fp32 scales cross the link (2-4x fewer bytes than a
-    bf16/fp32 checkpoint stream; the big-model-inference load metric is
-    usually link-bound)."""
+    int8/int4 bytes + (possibly double-quantized) scales cross the link
+    (2-4x fewer bytes than a bf16/fp32 checkpoint stream; the
+    big-model-inference load metric is usually link-bound)."""
     w = np.asarray(w)
     orig_dtype = w.dtype
     k = w.shape[0]
     g = group_size if (group_size > 0 and k % group_size == 0) else k
-    qmax = float(2 ** (bits - 1) - 1)
     w32 = np.asarray(w, np.float32).reshape(k // g, g, *w.shape[1:])
     amax = np.max(np.abs(w32), axis=1, keepdims=True)
-    scale = np.where(amax > 0, amax / qmax, 1.0).astype(np.float32)
-    q = np.clip(np.round(w32 / scale), -qmax, qmax).astype(np.int8)
+    if qtype == "nf4":
+        if bits != 4:
+            raise ValueError("nf4 is a 4-bit code")
+        scale = np.where(amax > 0, amax, 1.0).astype(np.float32)
+        normed = w32 / scale
+        # nearest NF4 level via the midpoint boundaries (the code is sorted)
+        q = np.searchsorted(_NF4_MIDPOINTS, normed).astype(np.int8)
+    else:
+        qmax = float(2 ** (bits - 1) - 1)
+        scale = np.where(amax > 0, amax / qmax, 1.0).astype(np.float32)
+        q = np.clip(np.round(w32 / scale), -qmax, qmax).astype(np.int8)
     q = q.reshape(w.shape)
     scale = scale[:, 0]
     if bits == 4:
@@ -122,7 +199,48 @@ def quantize_array_host(w: np.ndarray, bits: int = 8, group_size: int = 128) -> 
         lo = q[0::2] & 0x0F
         hi = (q[1::2] & 0x0F) << 4
         q = (lo | hi).astype(np.int8)
-    return QuantizedWeight(q, scale, w.shape, bits, g, orig_dtype)
+    if double_quant:
+        scale = _quantize_scales_host(scale)
+    return QuantizedWeight(q, scale, w.shape, bits, g, orig_dtype, qtype)
+
+
+def _quantize_scales_host(scale: np.ndarray) -> QuantizedScale:
+    """Second-level quantization of the per-group scales (reference
+    bnb_4bit_use_double_quant) — ~8.5 effective bits per scale instead
+    of 32.
+
+    Quantized in the LOG domain: absmax scales are positive with a heavy
+    right tail (one outlier channel per block would ruin a linear int8 code
+    for every other scale in its block — bnb uses a non-linear dynamic code
+    for the same reason). log compresses that dynamic range, so the int8
+    step is a small RELATIVE error on every scale: even a 2000x outlier
+    spread costs at most exp(log_range/254) - 1 ≈ 3% per scale."""
+    shape = scale.shape
+    flat = np.log(np.maximum(scale.reshape(-1).astype(np.float32), 1e-30))
+    offset = np.float32(flat.mean())
+    centered = flat - offset
+    n = flat.size
+    nblocks = max(1, -(-n // _DOUBLE_QUANT_BLOCK))
+    pad = nblocks * _DOUBLE_QUANT_BLOCK - n
+    if pad:
+        centered = np.concatenate([centered, np.zeros(pad, np.float32)])
+    blocks = centered.reshape(nblocks, _DOUBLE_QUANT_BLOCK)
+    amax = np.abs(blocks).max(axis=1, keepdims=True)
+    scale2 = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q8 = np.clip(np.round(blocks / scale2), -127, 127).astype(np.int8)
+    return QuantizedScale(q8.reshape(-1)[:n].reshape(shape), scale2[:, 0], offset, shape)
+
+
+def _dequantize_scales(qs: QuantizedScale):
+    """In-graph inverse of _quantize_scales_host (log-domain)."""
+    n = int(np.prod(qs.shape)) if qs.shape else 1
+    flat = qs.data.reshape(-1).astype(jnp.float32)
+    nblocks = qs.scale2.shape[0]
+    pad = nblocks * _DOUBLE_QUANT_BLOCK - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.float32)])
+    blocks = flat.reshape(nblocks, _DOUBLE_QUANT_BLOCK) * qs.scale2[:, None]
+    return jnp.exp(blocks.reshape(-1)[:n] + qs.offset).reshape(qs.shape)
 
 
 def quantize_abstract(leaf, config: QuantizationConfig) -> QuantizedWeight:
@@ -136,10 +254,20 @@ def quantize_abstract(leaf, config: QuantizationConfig) -> QuantizedWeight:
     if config.bits == 4:
         data_shape = ((k + 1) // 2,) + shape[1:]
     scale_shape = (k // g,) + shape[1:]
+    scale = jax.ShapeDtypeStruct(scale_shape, jnp.float32)
+    if config.double_quant:
+        n = int(np.prod(scale_shape)) if scale_shape else 1
+        nblocks = max(1, -(-n // _DOUBLE_QUANT_BLOCK))
+        scale = QuantizedScale(
+            jax.ShapeDtypeStruct(scale_shape, jnp.int8),
+            jax.ShapeDtypeStruct((nblocks,), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            scale_shape,
+        )
     return QuantizedWeight(
         jax.ShapeDtypeStruct(data_shape, jnp.int8),
-        jax.ShapeDtypeStruct(scale_shape, jnp.float32),
-        shape, config.bits, g, leaf.dtype,
+        scale,
+        shape, config.bits, g, leaf.dtype, config.quant_type,
     )
 
 
@@ -178,15 +306,27 @@ def quantize_abstract_tree(abstract_params, config, *, placement=None, leaf_dtyp
 def dequantize_array(qw: QuantizedWeight):
     """Inverse of quantize_array; XLA fuses this into the consumer matmul."""
     data = qw.data
+    nf4 = getattr(qw, "qtype", "linear") == "nf4"
     if qw.bits == 4:
-        lo = (data << 4).astype(jnp.int8) >> 4  # sign-extend low nibble
-        hi = data >> 4  # arithmetic shift sign-extends the high nibble
+        if nf4:
+            # UNSIGNED nibbles: codebook indices 0..15
+            lo = data & 0x0F
+            hi = (data >> 4) & 0x0F  # mask off the arithmetic-shift sign fill
+        else:
+            lo = (data << 4).astype(jnp.int8) >> 4  # sign-extend low nibble
+            hi = data >> 4  # arithmetic shift sign-extends the high nibble
         k = qw.shape[0]
         data = jnp.stack([lo, hi], axis=1).reshape(2 * data.shape[0], *qw.shape[1:])
         data = data[:k]  # drop the pad row when K was odd
+    scale = qw.scale
+    if isinstance(scale, QuantizedScale):
+        scale = _dequantize_scales(scale)
     k, g = qw.shape[0], qw.group
-    w = data.astype(jnp.float32).reshape(k // g, g, *qw.shape[1:])
-    w = w * qw.scale[:, None]
+    if nf4:
+        w = jnp.take(jnp.asarray(NF4_CODE), data.astype(jnp.int32), axis=0)
+    else:
+        w = data.astype(jnp.float32)
+    w = w.reshape(k // g, g, *qw.shape[1:]) * scale[:, None]
     return w.reshape(qw.shape).astype(qw.dtype)
 
 
@@ -211,7 +351,10 @@ def quantize_params(params, config: QuantizationConfig):
     out = {}
     for path, leaf in flat.items():
         if _eligible(path, leaf, config):
-            out[path] = quantize_array(leaf, bits=config.bits, group_size=config.group_size)
+            out[path] = quantize_array(
+                leaf, bits=config.bits, group_size=config.group_size,
+                qtype=config.quant_type, double_quant=config.double_quant,
+            )
         else:
             out[path] = leaf
     return unflatten_to_like(out, params)

@@ -258,3 +258,124 @@ class TestQuantizedMeshLoad:
         assert n_packed > 0
         out = dm(sample)
         assert np.isfinite(np.asarray(out["logits"])).all()
+
+
+class TestNF4AndDoubleQuant:
+    """NF4 codebook + double quantization (reference bnb.py
+    bnb_4bit_quant_type='nf4' / bnb_4bit_use_double_quant)."""
+
+    def _normal_weight(self, seed=7, shape=(128, 32)):
+        return jax.random.normal(jax.random.PRNGKey(seed), shape) * 0.02
+
+    def test_nf4_roundtrip_beats_linear_int4(self):
+        """On normal-distributed weights (what trained nets have), the NF4
+        quantile code must reconstruct better than uniform int4."""
+        w = self._normal_weight()
+        err = {}
+        for qtype in ("linear", "nf4"):
+            qw = quantize_array(w, bits=4, group_size=32, qtype=qtype)
+            back = dequantize_array(qw)
+            err[qtype] = float(jnp.mean((back - w) ** 2))
+        assert err["nf4"] < err["linear"], err
+
+    def test_nf4_exact_on_codebook_multiples(self):
+        """Group absmax * codebook values must roundtrip exactly."""
+        from accelerate_tpu.utils.quantization import NF4_CODE
+
+        scale = 0.37
+        w = jnp.asarray(np.tile(NF4_CODE * scale, 8).reshape(8, 16).T)  # [16, 8]
+        qw = quantize_array(w, bits=4, group_size=16, qtype="nf4")
+        back = dequantize_array(qw)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(w), rtol=1e-6)
+
+    def test_double_quant_roundtrip_close_and_smaller(self):
+        from accelerate_tpu.utils.quantization import QuantizedScale, quantized_nbytes
+
+        w = self._normal_weight(shape=(512, 64))
+        plain = quantize_array(w, bits=4, group_size=32, qtype="nf4")
+        double = quantize_array(w, bits=4, group_size=32, qtype="nf4", double_quant=True)
+        assert isinstance(double.scale, QuantizedScale)
+        back_p = dequantize_array(plain)
+        back_d = dequantize_array(double)
+        mse_p = float(jnp.mean((back_p - w) ** 2))
+        mse_d = float(jnp.mean((back_d - w) ** 2))
+        assert mse_d < mse_p * 1.5, (mse_p, mse_d)  # scales carry ~8.5 bits, tiny hit
+        assert quantized_nbytes(double) < quantized_nbytes(plain)
+
+    def test_odd_k_nf4_roundtrips(self):
+        w = self._normal_weight(shape=(15, 8))
+        qw = quantize_array(w, bits=4, group_size=0, qtype="nf4")
+        assert qw.data.shape == (8, 8)  # packed with a pad row
+        back = dequantize_array(qw)
+        assert back.shape == (15, 8)
+        assert float(jnp.mean((back - w) ** 2)) < 1e-5
+
+    def test_abstract_mirrors_host_shapes(self):
+        from accelerate_tpu.utils.quantization import quantize_abstract
+
+        cfg = QuantizationConfig(load_in_4bit=True, group_size=32,
+                                 quant_type="nf4", double_quant=True)
+        w = np.zeros((128, 48), np.float32)
+        concrete = quantize_array(jnp.asarray(w), bits=4, group_size=32,
+                                  qtype="nf4", double_quant=True)
+        abstract = quantize_abstract(jax.ShapeDtypeStruct(w.shape, jnp.float32), cfg)
+        ca = jax.tree_util.tree_map(lambda l: (tuple(l.shape), jnp.dtype(l.dtype)), concrete)
+        ab = jax.tree_util.tree_map(lambda l: (tuple(l.shape), jnp.dtype(l.dtype)), abstract)
+        c_leaves = jax.tree_util.tree_leaves(ca, is_leaf=lambda x: isinstance(x, tuple))
+        a_leaves = jax.tree_util.tree_leaves(ab, is_leaf=lambda x: isinstance(x, tuple))
+        assert c_leaves == a_leaves, (c_leaves, a_leaves)
+
+    def test_invalid_configs_raise(self):
+        with pytest.raises(ValueError, match="nf4"):
+            QuantizationConfig(load_in_8bit=True, quant_type="nf4")
+        with pytest.raises(ValueError, match="double_quant"):
+            QuantizationConfig(load_in_8bit=True, double_quant=True)
+        with pytest.raises(ValueError, match="quant_type"):
+            QuantizationConfig(load_in_4bit=True, quant_type="fp5")
+
+    def test_dispatch_decode_logits_nf4_vs_linear(self, tmp_path):
+        """Dispatch-path comparison (round-3 VERDICT #8): load the same
+        checkpoint as int4-linear and nf4+double-quant; both must produce
+        logits close to dense, with nf4 at least as close."""
+        from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+        from accelerate_tpu.models import DecoderConfig, DecoderLM
+        from accelerate_tpu.parallel.sharding import unbox_params
+        from accelerate_tpu.utils.serialization import save_pytree
+
+        cfg = DecoderConfig.tiny()
+        model = DecoderLM(cfg)
+        variables = model.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+        params, _ = unbox_params(variables["params"])
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (1, 16)))
+        dense = np.asarray(model.apply({"params": params}, ids)["logits"])
+        ckpt = tmp_path / "model.safetensors"
+        save_pytree(params, str(ckpt))
+
+        err = {}
+        for name, qc in {
+            "linear": QuantizationConfig(load_in_4bit=True, group_size=32),
+            "nf4": QuantizationConfig(load_in_4bit=True, group_size=32,
+                                      quant_type="nf4", double_quant=True),
+        }.items():
+            dm = load_checkpoint_and_dispatch(
+                model, str(ckpt), ids, device_map="auto",
+                quantization_config=qc, rng=jax.random.PRNGKey(0),
+            )
+            out = np.asarray(dm(ids)["logits"])
+            err[name] = float(np.abs(out - dense).max() / (np.abs(dense).max() + 1e-6))
+        assert err["nf4"] < 0.35 and err["linear"] < 0.35, err
+        assert err["nf4"] <= err["linear"] * 1.1, err
+
+    def test_double_quant_survives_outlier_scales(self):
+        """Log-domain scale quantization: one outlier channel must not ruin
+        the other 255 scales in its block (round-4 review — a linear int8
+        code degraded reconstruction 700x here)."""
+        rng = np.random.RandomState(11)
+        w = rng.randn(2048, 4).astype(np.float32) * 0.02
+        w[100, 0] = 100.0  # one outlier weight -> one outlier group scale
+        w = jnp.asarray(w)
+        plain = quantize_array(w, bits=4, group_size=64, qtype="nf4")
+        double = quantize_array(w, bits=4, group_size=64, qtype="nf4", double_quant=True)
+        mse_p = float(jnp.mean((dequantize_array(plain) - w) ** 2))
+        mse_d = float(jnp.mean((dequantize_array(double) - w) ** 2))
+        assert mse_d < mse_p * 2.0, (mse_p, mse_d)

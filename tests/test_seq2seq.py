@@ -179,3 +179,50 @@ class TestSeq2SeqMesh:
         losses = [float(jax.device_get(step(batch)["loss"])) for _ in range(3)]
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
+
+
+class TestSeq2SeqQuantizedGeneration:
+    def test_generate_from_quantized_params(self):
+        """generate_seq2seq's default param_placer dequantizes in-graph, so
+        QuantizedWeight trees work like they do in generate()."""
+        from accelerate_tpu.generation import generate_seq2seq
+        from accelerate_tpu.utils.quantization import (
+            QuantizationConfig,
+            quantize_params,
+        )
+
+        model, cfg, params = _model_and_params(max_cache_len=8)
+        qparams = quantize_params(
+            params, QuantizationConfig(load_in_4bit=True, group_size=16,
+                                       quant_type="nf4", double_quant=True)
+        )
+        src = jnp.asarray(np.random.RandomState(7).randint(3, cfg.vocab_size, (2, 16)))
+        toks_q = generate_seq2seq(model, qparams, src, max_new_tokens=4)
+        toks_f = generate_seq2seq(model, params, src, max_new_tokens=4)
+        assert toks_q.shape == toks_f.shape == (2, 4)
+
+    def test_max_new_tokens_guard(self):
+        from accelerate_tpu.generation import generate_seq2seq
+
+        model, cfg, params = _model_and_params()
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            generate_seq2seq(model, params, jnp.zeros((1, 8), jnp.int32), max_new_tokens=0)
+
+    def test_generate_seq2seq_dispatched(self, tmp_path):
+        from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+        from accelerate_tpu.generation import generate_seq2seq_dispatched
+        from accelerate_tpu.utils.quantization import QuantizationConfig
+        from accelerate_tpu.utils.serialization import save_pytree
+
+        model, cfg, params = _model_and_params(max_cache_len=8)
+        ckpt = tmp_path / "model.safetensors"
+        save_pytree(params, str(ckpt))
+        src = jnp.zeros((1, 8), jnp.int32)
+        dm = load_checkpoint_and_dispatch(
+            model, str(ckpt), src, decoder_input_ids=jnp.zeros((1, 8), jnp.int32),
+            device_map="auto",
+            quantization_config=QuantizationConfig(load_in_4bit=True, group_size=16),
+            rng=jax.random.PRNGKey(0),
+        )
+        toks = generate_seq2seq_dispatched(dm, src, max_new_tokens=4)
+        assert toks.shape == (1, 4)
