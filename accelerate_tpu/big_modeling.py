@@ -556,20 +556,30 @@ def load_checkpoint_and_dispatch(
             except Exception as e:  # pragma: no cover - AOT is best-effort
                 compile_err.append(e)
 
-        compile_thread = threading.Thread(target=_compile, daemon=True)
+        def _timed_compile():
+            from .utils.phases import phase
+
+            with phase("aot_compile_thread"):
+                _compile()
+
+        compile_thread = threading.Thread(target=_timed_compile, daemon=True)
         compile_thread.start()
 
-    params = load_checkpoint_in_model(
-        abstract_params,
-        checkpoint,
-        device_map=device_map,
-        offload_folder=offload_folder,
-        dtype=dtype,
-        mesh=mesh,
-        quantization_config=quantization_config,
-    )
+    from .utils.phases import phase
+
+    with phase("weight_stream_total"):
+        params = load_checkpoint_in_model(
+            abstract_params,
+            checkpoint,
+            device_map=device_map,
+            offload_folder=offload_folder,
+            dtype=dtype,
+            mesh=mesh,
+            quantization_config=quantization_config,
+        )
     if compile_thread is not None:
-        compile_thread.join()
+        with phase("aot_join_wait"):
+            compile_thread.join()
     if model is not None and not compile_err:
         model.params = params
         return model

@@ -28,19 +28,25 @@ _LIB_TRIED = False
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc", "att_runtime.cpp")
 _OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+_CFLAGS = ["-O3", "-march=native", "-funroll-loops", "-std=c++17", "-shared", "-fPIC", "-pthread"]
 
 
 def _build() -> Optional[str]:
-    # The artifact name embeds the source hash, so a stale binary (from an
-    # older source revision) can never be picked up: it simply isn't at the
+    # The artifact name embeds the source hash, the compile flags, AND the
+    # host arch (the -march=native binary is machine-specific), so a stale
+    # or foreign binary can never be picked up: it simply isn't at the
     # expected path and a fresh build runs. _build/ is never committed.
+    import platform
+
     try:
         with open(_SRC, "rb") as f:
-            src_hash = hashlib.sha256(f.read()).hexdigest()[:16]
+            key = hashlib.sha256(
+                f.read() + " ".join(_CFLAGS).encode() + platform.machine().encode()
+            ).hexdigest()[:16]
     except OSError as e:  # pragma: no cover - source missing
         logger.warning(f"att_runtime source unreadable ({e}); using Python fallbacks")
         return None
-    out = os.path.join(_OUT_DIR, f"libatt_runtime-{src_hash}.so")
+    out = os.path.join(_OUT_DIR, f"libatt_runtime-{key}.so")
     if os.path.exists(out):
         return out
     os.makedirs(_OUT_DIR, exist_ok=True)
@@ -49,7 +55,7 @@ def _build() -> Optional[str]:
     # checkout) or an interrupted g++ can never leave a half-written .so at
     # the path other processes load.
     tmp = f"{out}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
+    cmd = ["g++", *_CFLAGS, _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
@@ -94,6 +100,13 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             ("att_ring_release_read", [ctypes.c_void_p, ctypes.c_int], None),
             ("att_ring_slot_ptr", [ctypes.c_void_p, ctypes.c_int], ctypes.c_void_p),
             ("att_ring_slot_bytes", [ctypes.c_void_p], ctypes.c_uint64),
+            (
+                "att_quantize_group",
+                [ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+                 ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+                 ctypes.c_void_p, ctypes.c_int],
+                ctypes.c_int,
+            ),
         ]:
             fn = getattr(lib, name)
             fn.argtypes = argtypes
@@ -168,3 +181,44 @@ def parallel_memcpy(dests: Sequence[np.ndarray], srcs: Sequence[np.ndarray], num
         len(dests),
         num_threads,
     )
+
+
+def quantize_group_native(w: np.ndarray, group: int, bits: int, nf4: bool):
+    """Single-pass per-group quantization of a [K, ...] array along dim 0 in
+    C (see csrc att_quantize_group). Returns (packed int8 data, fp32 scales)
+    with the same layout utils/quantization.quantize_array_host produces, or
+    None when the native library / dtype / layout can't serve the request
+    (caller falls back to numpy). The C call releases the GIL, so a loader
+    thread can overlap quantization with async device transfers."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    if w.ndim < 1:
+        return None
+    k = w.shape[0]
+    n = int(np.prod(w.shape[1:])) if w.ndim > 1 else 1
+    if k == 0 or n == 0 or k % group != 0:
+        return None
+    if bits == 4 and group % 2 != 0 and k != group:
+        return None
+    if bits == 4 and k % 2 != 0 and k != group:
+        return None
+    import ml_dtypes
+
+    if w.dtype == np.float32:
+        src_dtype = 0
+    elif w.dtype == ml_dtypes.bfloat16:
+        src_dtype = 1
+    else:
+        return None
+    w = np.ascontiguousarray(w)
+    out_rows = k if bits == 8 else (k + 1) // 2
+    out_q = np.empty((out_rows,) + w.shape[1:], np.int8)
+    out_scale = np.empty((k // group,) + w.shape[1:], np.float32)
+    rc = lib.att_quantize_group(
+        w.ctypes.data, src_dtype, k, n, group, bits, 1 if nf4 else 0,
+        out_q.ctypes.data, out_scale.ctypes.data, os.cpu_count() or 1,
+    )
+    if rc != 0:
+        return None
+    return out_q, out_scale

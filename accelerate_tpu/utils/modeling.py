@@ -369,14 +369,22 @@ def load_checkpoint_in_model(
             )
         )
 
+    from .phases import phase
+
     disk_dict = {}
     out: dict[str, Any] = {}
     for path, abstract in flat_abstract.items():
-        value = np.asarray(flat_loaded[path])
-        # jnp.issubdtype, not np: ml_dtypes bf16 is floating too (and the
-        # dispatch AOT precompile predicts the cast with the same predicate)
-        if dtype is not None and jnp.issubdtype(jnp.dtype(value.dtype), jnp.floating):
-            value = value.astype(dtype)
+        with phase("ckpt_read"):
+            value = np.asarray(flat_loaded[path])
+            # jnp.issubdtype, not np: ml_dtypes bf16 is floating too (and the
+            # dispatch AOT precompile predicts the cast with the same predicate)
+            if dtype is not None and jnp.issubdtype(jnp.dtype(value.dtype), jnp.floating):
+                value = value.astype(dtype)
+            elif value.base is not None and isinstance(value.base, np.memmap):
+                # materialize lazy mmap views HERE so the phase breakdown
+                # attributes the disk read to ckpt_read, not to whatever
+                # first touches the pages (the quantize kernel's absmax scan)
+                value = np.array(value, copy=True)
         tier = placement_of(path, device_map)
         if quantization_config is not None and tier == "device":
             from .quantization import _eligible, quantize_array_host
@@ -384,39 +392,43 @@ def load_checkpoint_in_model(
             if _eligible(path, value, quantization_config):
                 # quantize ON HOST, then ship only packed bytes + scales:
                 # 2-4x fewer bytes over the (often link-bound) transfer
-                qw = quantize_array_host(
-                    value, bits=quantization_config.bits,
-                    group_size=quantization_config.group_size,
-                    qtype=quantization_config.quant_type,
-                    double_quant=quantization_config.double_quant,
-                )
-                if shardings is not None:
-                    # shardings were inferred on the packed shapes above;
-                    # every child (data/scale, incl. nested QuantizedScale
-                    # under double quant) has its own "<path>/<child>" entry
-                    sub = flatten_pytree(qw)
-                    placed = {
-                        k: jax.device_put(jnp.asarray(v), shardings[f"{path}/{k}"])
-                        for k, v in sub.items()
-                    }
-                    qw = unflatten_to_like(placed, qw)
-                else:
-                    qw = jax.tree_util.tree_map(jnp.asarray, qw)
+                with phase("host_quantize"):
+                    qw = quantize_array_host(
+                        value, bits=quantization_config.bits,
+                        group_size=quantization_config.group_size,
+                        qtype=quantization_config.quant_type,
+                        double_quant=quantization_config.double_quant,
+                    )
+                with phase("transfer_submit"):
+                    if shardings is not None:
+                        # shardings were inferred on the packed shapes above;
+                        # every child (data/scale, incl. nested QuantizedScale
+                        # under double quant) has its own "<path>/<child>" entry
+                        sub = flatten_pytree(qw)
+                        placed = {
+                            k: jax.device_put(jnp.asarray(v), shardings[f"{path}/{k}"])
+                            for k, v in sub.items()
+                        }
+                        qw = unflatten_to_like(placed, qw)
+                    else:
+                        qw = jax.tree_util.tree_map(jnp.asarray, qw)
                 out[path] = qw
                 continue
         if tier == "device":
-            if value.base is not None and isinstance(value.base, np.memmap):
-                # lift mmap-backed views into RAM before the transfer: the
-                # runtime's h2d path can fall off its fast path on
-                # mmap-backed/unaligned sources, and the copy (~GB/s) is
-                # cheap insurance. Reads stay lazy until exactly here, so
-                # disk I/O still overlaps the previous tensor's transfer
-                # (device_put is async).
-                value = np.array(value, copy=True)
-            if shardings is not None:
-                out[path] = jax.device_put(jnp.asarray(value), shardings[path])
-            else:
-                out[path] = jnp.asarray(value)
+            with phase("ckpt_read"):
+                if value.base is not None and isinstance(value.base, np.memmap):
+                    # lift mmap-backed views into RAM before the transfer: the
+                    # runtime's h2d path can fall off its fast path on
+                    # mmap-backed/unaligned sources, and the copy (~GB/s) is
+                    # cheap insurance. Reads stay lazy until exactly here, so
+                    # disk I/O still overlaps the previous tensor's transfer
+                    # (device_put is async).
+                    value = np.array(value, copy=True)
+            with phase("transfer_submit"):
+                if shardings is not None:
+                    out[path] = jax.device_put(jnp.asarray(value), shardings[path])
+                else:
+                    out[path] = jnp.asarray(value)
         elif tier == "cpu":
             out[path] = _to_pinned_host(value)
         else:  # disk

@@ -1,0 +1,45 @@
+"""Lightweight phase timing for the dispatch/TTFT path.
+
+Off by default (a no-op context manager); ``collect_phases()`` arms a
+process-global collector that accumulates wall time per named phase —
+bench.py's TTFT worker uses it to publish WHERE dispatch time goes
+(checkpoint read / host quantize / transfer submit / compile / first
+forward) instead of a single opaque total.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+_ACTIVE: Optional[dict] = None
+
+
+def collect_phases() -> dict:
+    """Arm collection; returns the (live) dict of phase -> seconds."""
+    global _ACTIVE
+    _ACTIVE = {}
+    return _ACTIVE
+
+
+def phases_snapshot() -> dict:
+    return dict(_ACTIVE or {})
+
+
+@contextmanager
+def phase(name: str):
+    if _ACTIVE is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _ACTIVE[name] = _ACTIVE.get(name, 0.0) + (time.perf_counter() - t0)
+
+
+def add_phase(name: str, seconds: float) -> None:
+    """Record an externally-measured duration (e.g. a thread's wall time)."""
+    if _ACTIVE is not None:
+        _ACTIVE[name] = _ACTIVE.get(name, 0.0) + seconds

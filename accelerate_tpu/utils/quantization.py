@@ -174,31 +174,41 @@ def quantize_array_host(
     int8/int4 bytes + (possibly double-quantized) scales cross the link
     (2-4x fewer bytes than a bf16/fp32 checkpoint stream; the
     big-model-inference load metric is usually link-bound)."""
+    if qtype == "nf4" and bits != 4:
+        raise ValueError("nf4 is a 4-bit code")
     w = np.asarray(w)
     orig_dtype = w.dtype
     k = w.shape[0]
     g = group_size if (group_size > 0 and k % group_size == 0) else k
-    w32 = np.asarray(w, np.float32).reshape(k // g, g, *w.shape[1:])
-    amax = np.max(np.abs(w32), axis=1, keepdims=True)
-    if qtype == "nf4":
-        if bits != 4:
-            raise ValueError("nf4 is a 4-bit code")
-        scale = np.where(amax > 0, amax, 1.0).astype(np.float32)
-        normed = w32 / scale
-        # nearest NF4 level via the midpoint boundaries (the code is sorted)
-        q = np.searchsorted(_NF4_MIDPOINTS, normed).astype(np.int8)
+
+    # native single-pass kernel (csrc att_quantize_group) when available —
+    # the numpy path below costs ~7 full passes over fp32 temporaries, which
+    # is the serial host cost quantize-on-load pays before bytes can move
+    from ..runtime.native import quantize_group_native
+
+    native = quantize_group_native(w, g, bits, qtype == "nf4")
+    if native is not None:
+        q, scale = native
     else:
-        qmax = float(2 ** (bits - 1) - 1)
-        scale = np.where(amax > 0, amax / qmax, 1.0).astype(np.float32)
-        q = np.clip(np.round(w32 / scale), -qmax, qmax).astype(np.int8)
-    q = q.reshape(w.shape)
-    scale = scale[:, 0]
-    if bits == 4:
-        if k % 2:
-            q = np.concatenate([q, np.zeros((1,) + q.shape[1:], q.dtype)], axis=0)
-        lo = q[0::2] & 0x0F
-        hi = (q[1::2] & 0x0F) << 4
-        q = (lo | hi).astype(np.int8)
+        w32 = np.asarray(w, np.float32).reshape(k // g, g, *w.shape[1:])
+        amax = np.max(np.abs(w32), axis=1, keepdims=True)
+        if qtype == "nf4":
+            scale = np.where(amax > 0, amax, 1.0).astype(np.float32)
+            normed = w32 / scale
+            # nearest NF4 level via the midpoint boundaries (the code is sorted)
+            q = np.searchsorted(_NF4_MIDPOINTS, normed).astype(np.int8)
+        else:
+            qmax = float(2 ** (bits - 1) - 1)
+            scale = np.where(amax > 0, amax / qmax, 1.0).astype(np.float32)
+            q = np.clip(np.round(w32 / scale), -qmax, qmax).astype(np.int8)
+        q = q.reshape(w.shape)
+        scale = scale[:, 0]
+        if bits == 4:
+            if k % 2:
+                q = np.concatenate([q, np.zeros((1,) + q.shape[1:], q.dtype)], axis=0)
+            lo = q[0::2] & 0x0F
+            hi = (q[1::2] & 0x0F) << 4
+            q = (lo | hi).astype(np.int8)
     if double_quant:
         scale = _quantize_scales_host(scale)
     return QuantizedWeight(q, scale, w.shape, bits, g, orig_dtype, qtype)

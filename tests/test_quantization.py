@@ -379,3 +379,47 @@ class TestNF4AndDoubleQuant:
         mse_p = float(jnp.mean((dequantize_array(plain) - w) ** 2))
         mse_d = float(jnp.mean((dequantize_array(double) - w) ** 2))
         assert mse_d < mse_p * 2.0, (mse_p, mse_d)
+
+
+class TestNativeQuantizeKernel:
+    """csrc att_quantize_group must be BIT-EXACT with the numpy fallback
+    (same rounding: division + half-even), or native availability would
+    silently change model numerics."""
+
+    @pytest.mark.parametrize("bits,qtype", [(8, "linear"), (4, "linear"), (4, "nf4")])
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_native_matches_numpy(self, bits, qtype, dtype):
+        import ml_dtypes
+
+        import accelerate_tpu.runtime.native as native_mod
+        from accelerate_tpu.runtime.native import native_available
+
+        if not native_available():
+            pytest.skip("native runtime unavailable")
+        np_dtype = ml_dtypes.bfloat16 if dtype == "bfloat16" else np.float32
+        w = (np.random.RandomState(3).standard_normal((256, 48)) * 0.02).astype(np_dtype)
+        q_native = quantize_array(jnp.asarray(w).astype(w.dtype) if dtype == "float32" else w,
+                                  bits=bits, group_size=64, qtype=qtype)
+        orig = native_mod.quantize_group_native
+        native_mod.quantize_group_native = lambda *a, **k: None
+        try:
+            q_numpy = quantize_array(w, bits=bits, group_size=64, qtype=qtype)
+        finally:
+            native_mod.quantize_group_native = orig
+        np.testing.assert_array_equal(np.asarray(q_native.data), np.asarray(q_numpy.data))
+        np.testing.assert_allclose(
+            np.asarray(q_native.scale), np.asarray(q_numpy.scale), rtol=1e-6
+        )
+
+    def test_native_odd_k_falls_back(self):
+        """Layouts the C kernel declines (odd group over MULTIPLE groups:
+        int4 pairs would straddle group boundaries) must silently use
+        numpy, not fail."""
+        from accelerate_tpu.runtime.native import quantize_group_native
+
+        w = np.random.RandomState(4).standard_normal((15, 8)).astype(np.float32)
+        assert quantize_group_native(w, 5, 4, False) is None  # declined
+        qw = quantize_array(w, bits=4, group_size=5)
+        assert qw.data.shape == (8, 8)
+        back = dequantize_array(qw)
+        assert float(jnp.mean((back - w) ** 2)) < 1e-2
