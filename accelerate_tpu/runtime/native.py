@@ -33,15 +33,28 @@ _CFLAGS = ["-O3", "-march=native", "-funroll-loops", "-std=c++17", "-shared", "-
 
 def _build() -> Optional[str]:
     # The artifact name embeds the source hash, the compile flags, AND the
-    # host arch (the -march=native binary is machine-specific), so a stale
-    # or foreign binary can never be picked up: it simply isn't at the
-    # expected path and a fresh build runs. _build/ is never committed.
+    # host CPU's feature flags (the -march=native binary is
+    # microarchitecture-specific: a checkout/_build shared across machines
+    # of the same arch but different ISA extensions must rebuild, not
+    # SIGILL), so a stale or foreign binary can never be picked up: it
+    # simply isn't at the expected path and a fresh build runs. _build/ is
+    # never committed.
     import platform
 
+    cpu_flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    cpu_flags = line
+                    break
+    except OSError:  # pragma: no cover - non-Linux
+        pass
     try:
         with open(_SRC, "rb") as f:
             key = hashlib.sha256(
-                f.read() + " ".join(_CFLAGS).encode() + platform.machine().encode()
+                f.read() + " ".join(_CFLAGS).encode()
+                + platform.machine().encode() + cpu_flags.encode()
             ).hexdigest()[:16]
     except OSError as e:  # pragma: no cover - source missing
         logger.warning(f"att_runtime source unreadable ({e}); using Python fallbacks")

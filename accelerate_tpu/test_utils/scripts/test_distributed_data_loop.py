@@ -185,6 +185,36 @@ def test_even_batches_off(accelerator, batch_size):
     accelerator.print("even_batches=False exact cover OK")
 
 
+def test_dispatch_split_batches(accelerator, batch_size):
+    """dispatch x split_batches: rank 0 reads GLOBAL batches of the
+    configured size, every rank steps the same count, coverage exact
+    (the uneven x dispatch combination of the reference matrix)."""
+    from accelerate_tpu.data import DataLoader
+    from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+    world = accelerator.num_processes
+    global_bs = batch_size * world
+    n = global_bs * 2 + world + 1  # ragged tail through the dispatch path
+    accelerator.dataloader_config = DataLoaderConfiguration(
+        split_batches=True, dispatch_batches=True
+    )
+    dl = accelerator.prepare(DataLoader(ArangeDataset(n), batch_size=global_bs))
+    accelerator.dataloader_config = DataLoaderConfiguration()
+    kept = []
+    steps = 0
+    for batch in dl:
+        assert len(_ids(batch)) == global_bs  # static shape incl. padded tail
+        out = accelerator.gather_for_metrics(batch["x"])
+        kept += np.asarray(out)[:, 0].astype(int).tolist()
+        steps += 1
+    from accelerate_tpu.utils.operations import gather_object
+
+    counts = gather_object([steps])
+    assert len(set(counts)) == 1, counts  # all ranks stepped together
+    assert sorted(kept) == list(range(n)), (sorted(kept)[:10], n)
+    accelerator.print("dispatch x split_batches ragged coverage OK")
+
+
 def main():
     from accelerate_tpu import Accelerator
 
@@ -198,6 +228,7 @@ def main():
     test_dispatch_mode(accelerator, bs * world * 4, bs)
     test_dispatch_ragged_tail(accelerator, bs)
     test_dispatch_local_slice(accelerator, bs)
+    test_dispatch_split_batches(accelerator, bs)
     test_even_batches_off(accelerator, bs)
     test_split_batches(accelerator, 8 * world * 2)
     test_skip_first_batches(accelerator, bs * world * 4, bs)

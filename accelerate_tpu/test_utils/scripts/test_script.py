@@ -370,6 +370,30 @@ def training_check(accelerator_factory):
     # (fewer, averaged steps); the accumulation==big-batch parity lives in
     # test_sync.py::test_accumulation_matches_big_batch.
 
+    # x split_batches (reference training_check sweeps it): batch_size is
+    # GLOBAL, each process sees batch/num_processes rows, and the update
+    # trajectory must match the per-process-batch run EXACTLY (same global
+    # batches in the same order)
+    from accelerate_tpu import DataLoaderConfiguration
+
+    for accum in (1, 2):
+        accelerator = accelerator_factory(
+            mixed_precision="bf16",
+            gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=accum),
+            dataloader_config=DataLoaderConfiguration(split_batches=True),
+        )
+        model, losses = _train(
+            accelerator, batch_size=8 * accelerator.num_processes
+        )
+        assert losses[-1] < losses[0], ("split_batches", accum, losses)
+        split_params = {k: np.asarray(v) for k, v in model.params.items()}
+        for key, ref_val in final[("bf16", accum)].items():
+            np.testing.assert_allclose(
+                split_params[key], ref_val, rtol=1e-5, atol=1e-6,
+                err_msg=f"split_batches diverged from per-process batches (accum={accum})",
+            )
+        accelerator.print(f"training check OK (split_batches, accum={accum})")
+
 
 def grad_compression_check(accelerator_factory):
     """Compressed cross-replica gradient all-reduce under REAL processes:

@@ -158,12 +158,39 @@ def test_no_sync_suppresses_update(accelerator_factory):
     accelerator.print("no_sync suppresses update OK")
 
 
+def test_sync_each_batch_updates_params(accelerator_factory, accum_steps: int = 4):
+    """sync_each_batch must not just SET the flag — params must move on
+    every batch (the reference sweep's observable, test_sync.py:369-404)."""
+    from accelerate_tpu import GradientAccumulationPlugin
+
+    accelerator = accelerator_factory(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=accum_steps, sync_each_batch=True
+        )
+    )
+    model, optimizer, dl = _setup(accelerator, length=32, batch_size=8)
+    prev = _params_np(model)
+    moved = []
+    for batch in dl:
+        with accelerator.accumulate(model):
+            out = model(batch["x"], batch["y"])
+            accelerator.backward(out["loss"])
+            optimizer.step()
+            optimizer.zero_grad()
+        cur = _params_np(model)
+        moved.append(any(not np.array_equal(prev[k], cur[k]) for k in cur))
+        prev = cur
+    assert all(moved), f"sync_each_batch left batches without an update: {moved}"
+    accelerator.print(f"sync_each_batch updates params every batch OK (accum={accum_steps})")
+
+
 def main():
     factory = _fresh_accelerator
     for accum in (1, 2, 3):
         test_sync_flag_pattern(factory, accum)
-    for accum in (2, 4):  # the sync_each_batch x accum matrix rows
+    for accum in (1, 2, 4):  # the full sync_each_batch x accum matrix rows
         test_sync_each_batch(factory, accum)
+    test_sync_each_batch_updates_params(factory)
     test_dataloader_end_forces_sync(factory)
     test_accumulation_matches_big_batch(factory)
     test_no_sync_suppresses_update(factory)

@@ -374,18 +374,24 @@ def load_checkpoint_in_model(
     disk_dict = {}
     out: dict[str, Any] = {}
     for path, abstract in flat_abstract.items():
+        tier = placement_of(path, device_map)
         with phase("ckpt_read"):
             value = np.asarray(flat_loaded[path])
             # jnp.issubdtype, not np: ml_dtypes bf16 is floating too (and the
             # dispatch AOT precompile predicts the cast with the same predicate)
             if dtype is not None and jnp.issubdtype(jnp.dtype(value.dtype), jnp.floating):
                 value = value.astype(dtype)
-            elif value.base is not None and isinstance(value.base, np.memmap):
-                # materialize lazy mmap views HERE so the phase breakdown
-                # attributes the disk read to ckpt_read, not to whatever
-                # first touches the pages (the quantize kernel's absmax scan)
+            elif (
+                tier == "device"
+                and value.base is not None
+                and isinstance(value.base, np.memmap)
+            ):
+                # DEVICE tier only: materialize lazy mmap views here so the
+                # phase breakdown attributes the disk read to ckpt_read, not
+                # to whatever first touches the pages (the quantize kernel's
+                # absmax scan). cpu/disk tiers must STAY lazy — disk offload's
+                # whole point is not holding those bytes in RAM.
                 value = np.array(value, copy=True)
-        tier = placement_of(path, device_map)
         if quantization_config is not None and tier == "device":
             from .quantization import _eligible, quantize_array_host
 

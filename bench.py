@@ -521,8 +521,11 @@ def main():
         extra["long16k_train_mfu_pct"] = round(lc_mfu * 100, 2)
         extra["long16k_tokens_per_sec"] = round(lc_tok_s)
 
-        # the BASELINE nlp_example / cv_example rows (samples/sec/chip)
-        enc_sps, enc_mfu = _encoder_bench(64, 128, 12)
+        # the BASELINE nlp_example / cv_example rows (samples/sec/chip).
+        # 20 timed steps: at ~45 ms/step the 12-step window was narrow
+        # enough for tunnel-RTT noise to swing the row by several MFU points
+        # (r3 recorded 39.5% for a config that measures 47-53% standalone)
+        enc_sps, enc_mfu = _encoder_bench(64, 128, 20)
         extra["bert_base_samples_per_sec"] = round(enc_sps)
         extra["bert_base_train_mfu_pct"] = round(enc_mfu * 100, 2)
         extra["resnet50_samples_per_sec"] = round(_resnet_bench(64, 224, 12))

@@ -17,6 +17,10 @@ def cache_state(monkeypatch, tmp_path):
     prev_jax_dir = jax.config.jax_compilation_cache_dir
     monkeypatch.delenv("ATT_COMPILE_CACHE", raising=False)
     monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    # hermetic: the suite conftest legitimately pre-sets a shared cache dir,
+    # which the user-config branch would (correctly) respect — clear it so
+    # these tests see a pristine process regardless of ordering
+    jax.config.update("jax_compilation_cache_dir", None)
     yield monkeypatch, tmp_path
     cc._enabled_dir = prev_enabled
     jax.config.update("jax_compilation_cache_dir", prev_jax_dir)

@@ -63,6 +63,17 @@ class TestExamplesRun:
         assert r.returncode == 0, r.stderr
         assert "reversal_accuracy" in r.stdout
 
+    def test_grad_compression_example(self):
+        r = _run_example(os.path.join("by_feature", "grad_compression.py"))
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout and "grad_norm" in r.stdout
+
+    def test_peak_memory_tracking_example(self):
+        r = _run_example(os.path.join("by_feature", "peak_memory_tracking.py"))
+        assert r.returncode == 0, r.stderr
+        assert "accuracy" in r.stdout
+        assert "peak device memory" in r.stdout or "memory stats" in r.stdout
+
     def test_gradient_accumulation_example(self):
         r = _run_example(os.path.join("by_feature", "gradient_accumulation.py"),
                          "--gradient_accumulation_steps", "2")

@@ -72,3 +72,14 @@ class TestLaunchedDataLoop:
             ("test_utils", "scripts", "test_distributed_data_loop.py"), num_processes=4
         )
         assert "ALL DATA-LOOP CHECKS PASSED" in r.stdout
+
+
+@pytest.mark.slow
+class TestLaunchedContextParallel:
+    def test_ring_grad_parity_two_processes(self):
+        """flash-ring grads == dense-ring grads with the ring's ppermutes
+        crossing a REAL process boundary (round-3 VERDICT weak #7)."""
+        r = run_launched_script(
+            ("test_utils", "scripts", "test_context_parallel.py"), num_processes=2
+        )
+        assert "ALL CONTEXT-PARALLEL CHECKS PASSED" in r.stdout
