@@ -62,9 +62,14 @@ class DecoderConfig:
     pipeline_schedule: str = "gpipe"
     # KV-cache length for generation (None -> max_seq_len)
     max_cache_len: Optional[int] = None
-    # fp8 recipe (ops/fp8.py): MLP contractions run e4m3-fwd/e5m2-bwd with
-    # current scaling. Flipped on by Accelerator(mixed_precision="fp8").
+    # fp8 recipe (ops/fp8.py): MLP contractions run e4m3-fwd/e5m2-bwd.
+    # Flipped on by Accelerator(mixed_precision="fp8"). ``fp8_recipe``:
+    # "current" (per-tensor amax each step, XLA fuses the reduction) or
+    # "delayed" (TE DelayedScaling parity: scales from a rolling amax
+    # history threaded through the "fp8_stats" collection).
     use_fp8: bool = False
+    fp8_recipe: str = "current"
+    fp8_amax_history_len: int = 16
     # big-model inference: keep layer weights in pinned host RAM and
     # transfer each layer's slice to HBM inside the scan body, so peak HBM
     # is ~one layer + embedding, not the whole model (set automatically by
@@ -89,6 +94,16 @@ class DecoderConfig:
             raise ValueError(
                 f"pipeline_stages={self.pipeline_stages} must divide "
                 f"num_layers={self.num_layers} evenly"
+            )
+        if self.fp8_recipe not in ("current", "delayed"):
+            raise ValueError(
+                f"fp8_recipe must be 'current' or 'delayed', got {self.fp8_recipe!r}"
+            )
+        if self.fp8_recipe == "delayed" and self.pipeline_stages > 1:
+            raise NotImplementedError(
+                "delayed fp8 scaling + pipeline parallelism is not wired "
+                "(per-tick amax-history writes through the stage belt have "
+                "no defined semantics); use fp8_recipe='current'"
             )
         if self.pipeline_schedule not in ("gpipe", "1f1b"):
             raise ValueError(
@@ -188,6 +203,10 @@ class EncoderConfig:
     norm_eps: float = 1e-12
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    # fp8 MLP contractions (ops/fp8.py), same knobs as DecoderConfig
+    use_fp8: bool = False
+    fp8_recipe: str = "current"
+    fp8_amax_history_len: int = 16
 
     @classmethod
     def tiny(cls, **kw):

@@ -228,12 +228,12 @@ class DecoderMLP(nn.Module):
         wu = self.param("w_up", nn.with_logical_partitioning(_dense_init(), ("embed", "mlp")), (e, m))
         wd = self.param("w_down", nn.with_logical_partitioning(_dense_init(), ("mlp", "embed")), (m, e))
         dt = cfg.dtype
-        from ..ops.fp8 import maybe_fp8_dot
+        from ..ops.fp8 import module_fp8_dot
 
-        gate = maybe_fp8_dot(x, wg.astype(dt), cfg.use_fp8)
-        up = maybe_fp8_dot(x, wu.astype(dt), cfg.use_fp8)
+        gate = module_fp8_dot(self, "gate", x, wg.astype(dt), cfg)
+        up = module_fp8_dot(self, "up", x, wu.astype(dt), cfg)
         hidden = _constrain(swiglu(gate, up), ("batch", "seq", "mlp"), self.mesh)
-        return _constrain(maybe_fp8_dot(hidden, wd.astype(dt), cfg.use_fp8), ("batch", "seq", "embed"), self.mesh)
+        return _constrain(module_fp8_dot(self, "down", hidden, wd.astype(dt), cfg), ("batch", "seq", "embed"), self.mesh)
 
 
 class DecoderBlock(nn.Module):
@@ -300,7 +300,7 @@ class StageStack(nn.Module):
             body = nn.remat(body, prevent_cse=False, static_argnums=(), policy=_remat_policy(cfg))
         Stack = nn.scan(
             body,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "fp8_stats": 0},
             split_rngs={"params": True, "dropout": True},
             length=cfg.num_layers // cfg.pipeline_stages,
             metadata_params={nn.PARTITION_NAME: "layer"},
@@ -363,6 +363,11 @@ class DecoderLM(nn.Module):
                 split_microbatches,
             )
 
+            if cfg.use_fp8 and cfg.fp8_recipe == "delayed":
+                raise NotImplementedError(
+                    "delayed fp8 scaling + pipeline parallelism is not "
+                    "wired; use fp8_recipe='current'"
+                )
             if cfg.pipeline_stages <= 1:
                 cfg = dataclasses.replace(cfg, pipeline_stages=num_stages)
             num_micro = _adapt_microbatches(
@@ -389,7 +394,7 @@ class DecoderLM(nn.Module):
                 )
             ScanStack = nn.scan(
                 scan_body,
-                variable_axes={"params": 0, "cache": 0},
+                variable_axes={"params": 0, "cache": 0, "fp8_stats": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layer"},

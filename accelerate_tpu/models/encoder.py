@@ -66,9 +66,11 @@ class EncoderBlock(nn.Module):
         bi = self.param("b_in", nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)), (cfg.mlp_dim,))
         wo2 = self.param("w_out", nn.with_logical_partitioning(_dense_init(), ("mlp", "embed")), (cfg.mlp_dim, e))
         bo2 = self.param("b_out", nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)), (e,))
-        hidden = jax.nn.gelu(x @ wi.astype(dt) + bi.astype(dt))
+        from ..ops.fp8 import module_fp8_dot
+
+        hidden = jax.nn.gelu(module_fp8_dot(self, "mlp_in", x, wi.astype(dt), cfg) + bi.astype(dt))
         hidden = _constrain(hidden, ("batch", "seq", "mlp"), self.mesh)
-        out = hidden @ wo2.astype(dt) + bo2.astype(dt)
+        out = module_fp8_dot(self, "mlp_out", hidden, wo2.astype(dt), cfg) + bo2.astype(dt)
         if cfg.dropout_rate > 0.0:
             out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
         x = _layer_norm(x + out, ln2_s, ln2_b, cfg.norm_eps)

@@ -76,6 +76,8 @@ class Seq2SeqConfig:
     max_cache_len: Optional[int] = None  # decode cache (None -> max_target_len)
     # fp8 recipe on the MLP contractions (shared DecoderMLP, ops/fp8.py)
     use_fp8: bool = False
+    fp8_recipe: str = "current"
+    fp8_amax_history_len: int = 16
 
     def __post_init__(self):
         if self.num_decoder_layers is None:
@@ -261,7 +263,7 @@ def _stack(body_cls, cfg, length, use_cache=False):
     body = body_cls
     if cfg.remat and not use_cache:
         body = nn.remat(body, prevent_cse=False, static_argnums=(), policy=_remat_policy(cfg))
-    axes = {"params": 0}
+    axes = {"params": 0, "fp8_stats": 0}
     if use_cache:
         axes["cache"] = 0
     return nn.scan(

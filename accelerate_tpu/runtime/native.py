@@ -45,7 +45,8 @@ def _build() -> Optional[str]:
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
-                if line.startswith("flags"):
+                # x86 spells it "flags", aarch64 "Features"
+                if line.startswith(("flags", "Features")):
                     cpu_flags = line
                     break
     except OSError:  # pragma: no cover - non-Linux

@@ -72,7 +72,6 @@ def main():
     ref = np.asarray(mha_reference(jnp.asarray(q_full), jnp.asarray(k_full),
                                    jnp.asarray(v_full), causal=True))
     out_dense = fwd_dense(q, k, v)
-    my_slice = out_dense.sharding.addressable_devices_indices_map(out_dense.shape)
     local_dense = np.concatenate(
         [np.asarray(sh.data) for sh in out_dense.addressable_shards], axis=2
     )

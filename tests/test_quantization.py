@@ -423,3 +423,94 @@ class TestNativeQuantizeKernel:
         assert qw.data.shape == (8, 8)
         back = dequantize_array(qw)
         assert float(jnp.mean((back - w) ** 2)) < 1e-2
+
+
+class TestFp8DelayedScaling:
+    """TE DelayedScaling parity (reference transformer_engine.py:96-130):
+    scales come from a rolling amax HISTORY threaded through the model's
+    "fp8_stats" collection, which rides the TrainEngine's mutable state."""
+
+    def test_delayed_dot_matches_current_after_warmup(self):
+        from accelerate_tpu.ops.fp8 import fp8_dot, fp8_dot_delayed, init_amax_history
+
+        a = jax.random.normal(jax.random.PRNGKey(0), (32, 64)) * 2.0
+        b = jax.random.normal(jax.random.PRNGKey(1), (64, 16)) * 0.5
+        hist = init_amax_history(4)
+        out, hist = fp8_dot_delayed(a, b, hist)  # cold: scale=1 fallback
+        out2, hist = fp8_dot_delayed(a, b, hist)  # warm: history holds amax
+        ref = fp8_dot(a, b)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(ref), rtol=1e-6, atol=1e-6)
+        assert float(hist[0].max()) > 0 and float(hist[1].max()) > 0
+
+    def test_history_rides_over_transient_spike(self):
+        """The point of the recipe: one outlier step must not crush later
+        scales — max over the history keeps the bigger range in effect."""
+        from accelerate_tpu.ops.fp8 import _delayed_scale, _roll_in, E4M3_MAX
+
+        hist = jnp.zeros(4)
+        hist = _roll_in(hist, jnp.float32(8.0))   # steady amax
+        hist = _roll_in(hist, jnp.float32(100.0)) # spike
+        hist = _roll_in(hist, jnp.float32(8.0))
+        scale = _delayed_scale(hist, E4M3_MAX, 1.0)
+        np.testing.assert_allclose(float(scale), 100.0 / E4M3_MAX, rtol=1e-6)
+
+    def test_decoder_trains_with_delayed_recipe(self):
+        import dataclasses
+
+        import optax
+
+        from accelerate_tpu import Accelerator, Model
+        from accelerate_tpu.models import DecoderConfig, DecoderLM
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc = Accelerator(mixed_precision="fp8")
+        # use_fp8 must be on BEFORE init so the stats collection exists
+        cfg = dataclasses.replace(
+            DecoderConfig.tiny(), use_fp8=True, fp8_recipe="delayed",
+            fp8_amax_history_len=4,
+        )
+        model_def = DecoderLM(cfg, mesh=acc.mesh)
+        variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=8, seq_len=16)
+        assert "fp8_stats" in variables, list(variables)
+        model, opt = acc.prepare(Model(model_def, variables), optax.adam(1e-2))
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16)))
+        losses = []
+        for _ in range(5):
+            out = model(ids, labels=ids)
+            acc.backward(out["loss"])
+            opt.step()
+            opt.zero_grad()
+            losses.append(float(jax.device_get(out["loss"])))
+        assert losses[-1] < losses[0], losses
+        # the amax history must have advanced during training
+        stats = model._engine.extra_state["fp8_stats"]
+        hist_leaves = jax.tree_util.tree_leaves(stats)
+        assert any(float(jnp.max(h)) > 0 for h in hist_leaves)
+
+    def test_encoder_fp8_trains(self):
+        """fp8 hooks now exist in the encoder too (round-3 VERDICT #27)."""
+        import optax
+
+        from accelerate_tpu import Accelerator, Model
+        from accelerate_tpu.models import EncoderClassifier, EncoderConfig
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc = Accelerator(mixed_precision="fp8")
+        cfg = EncoderConfig.tiny(dropout_rate=0.0)
+        model_def = EncoderClassifier(cfg, mesh=acc.mesh)
+        variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=8, seq_len=16)
+        model, opt = acc.prepare(Model(model_def, variables), optax.adam(1e-3))
+        assert model._engine.model.definition.config.use_fp8  # _enable_fp8 flipped it
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)))
+        labels = jnp.asarray(rng.randint(0, cfg.num_labels, (8,)))
+        losses = []
+        for _ in range(8):
+            out = model(ids, labels=labels)
+            acc.backward(out["loss"])
+            opt.step()
+            opt.zero_grad()
+            losses.append(float(jax.device_get(out["loss"])))
+        assert losses[-1] < losses[0], losses
