@@ -25,24 +25,23 @@ def register(subparsers):
     return parser
 
 
-def _ask(question: str, default, cast=str, choices=None):
-    suffix = f" [{'/'.join(map(str, choices))}]" if choices else ""
-    raw = input(f"{question}{suffix} ({default}): ").strip()
+def _ask(question: str, default, cast=str):
+    raw = input(f"{question} ({default}): ").strip()
     if not raw:
         return default
-    value = cast(raw)
-    if choices and value not in choices:
-        print(f"  -> {value!r} not in {choices}, keeping {default!r}")
-        return default
-    return value
+    return cast(raw)
 
 
 def config_command(args) -> int:
     """Interactive flow (reference cluster.py questionnaire, TPU-sized:
-    no GPU-vendor questions, sharding degrees instead of plugin choices)."""
+    no GPU-vendor questions, sharding degrees instead of plugin choices).
+    Choice questions run through the arrow-key BulletMenu (reference
+    commands/menu/) on a TTY, numbered prompts otherwise."""
+    from .menu import choose
+
     cfg = ClusterConfig()
-    cfg.compute_environment = _ask(
-        "Compute environment", "LOCAL_MACHINE", str, ["LOCAL_MACHINE", "TPU_POD"]
+    cfg.compute_environment = choose(
+        "Compute environment", ["LOCAL_MACHINE", "TPU_POD"], "LOCAL_MACHINE"
     )
     if cfg.compute_environment == "TPU_POD":
         cfg.tpu_name = _ask("TPU pod name", "") or None
@@ -50,9 +49,11 @@ def config_command(args) -> int:
         cfg.num_processes = _ask("Number of hosts in the pod", 1, int)
     else:
         cfg.num_processes = _ask("Number of processes (hosts)", 1, int)
-    cfg.mixed_precision = _ask("Mixed precision", "bf16", str, ["no", "fp16", "bf16"])
-    cfg.sharding_strategy = _ask(
-        "Sharding strategy", "AUTO", str, ["AUTO", "DDP", "FSDP", "HYBRID", "GRAD_OP", "NONE"]
+    cfg.mixed_precision = choose("Mixed precision", ["no", "fp16", "bf16"], "bf16")
+    cfg.sharding_strategy = choose(
+        "Sharding strategy",
+        ["AUTO", "DDP", "FSDP", "HYBRID", "GRAD_OP", "NONE"],
+        "AUTO",
     )
     cfg.fsdp = _ask("FSDP (ZeRO) axis degree (-1 = all devices)", 1, int)
     cfg.tensor_parallel = _ask("Tensor-parallel degree", 1, int)

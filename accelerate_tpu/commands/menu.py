@@ -1,0 +1,120 @@
+"""Interactive cursor-selection menu for the config questionnaire.
+
+Parity target: the reference's ``commands/menu/`` package (BulletMenu —
+arrow-key selection with a highlighted cursor, reference
+``commands/menu/selection_menu.py`` + ``utils/rich.py``). Pure stdlib:
+raw-mode termios + ANSI redraw, no rich/curses dependency. When stdin is
+not a TTY (CI, piped input) it degrades to a numbered prompt, so scripted
+``yes ''``-style flows keep working.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_UP = ("\x1b[A", "k")
+_DOWN = ("\x1b[B", "j")
+
+
+def _read_key(fd: int) -> str:
+    """One keystroke from the raw fd. os.read, not the buffered stream:
+    select() peeks the FD, and buffered readers would already have drained
+    the escape sequence's continuation bytes into Python's buffer."""
+    import select
+
+    ch = os.read(fd, 1).decode(errors="replace")
+    if ch == "\x1b":
+        # Only consume continuation bytes that are ALREADY pending: a lone
+        # ESC press must not swallow the user's next keystroke (or block).
+        if not select.select([fd], [], [], 0.05)[0]:
+            return ch
+        nxt = os.read(fd, 1).decode(errors="replace")
+        if nxt == "[":
+            return "\x1b[" + os.read(fd, 1).decode(errors="replace")
+        return ch + nxt
+    return ch
+
+
+class BulletMenu:
+    """``BulletMenu("Mixed precision", ["no", "fp16", "bf16"]).run(default)``
+    returns the selected INDEX."""
+
+    def __init__(self, prompt: str, choices):
+        self.prompt = prompt
+        self.choices = [str(c) for c in choices]
+
+    # -- rendering -----------------------------------------------------
+    def _draw(self, pos: int, first: bool, out) -> None:
+        if not first:
+            out.write(f"\x1b[{len(self.choices)}A")  # cursor up N lines
+        for i, choice in enumerate(self.choices):
+            marker = "➤ " if i == pos else "  "
+            style = ("\x1b[7m", "\x1b[0m") if i == pos else ("", "")
+            out.write(f"\r\x1b[2K{marker}{style[0]}{choice}{style[1]}\n")
+        out.flush()
+
+    # -- drivers -------------------------------------------------------
+    def _run_tty(self, default: int) -> int:
+        import termios
+        import tty
+
+        out = sys.stdout
+        out.write(f"{self.prompt} (↑/↓ + enter):\n")
+        pos = default
+        self._draw(pos, True, out)
+        fd = sys.stdin.fileno()
+        old = termios.tcgetattr(fd)
+        try:
+            tty.setcbreak(fd)
+            while True:
+                key = _read_key(fd)
+                if key in _UP:
+                    pos = (pos - 1) % len(self.choices)
+                elif key in _DOWN:
+                    pos = (pos + 1) % len(self.choices)
+                elif key.isdigit() and int(key) < len(self.choices):
+                    pos = int(key)
+                elif key in ("\r", "\n"):
+                    return pos
+                elif key in ("\x03", "\x1b"):  # ctrl-c / lone esc
+                    raise KeyboardInterrupt
+                self._draw(pos, False, out)
+        finally:
+            termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+    def _run_plain(self, default: int) -> int:
+        print(self.prompt)
+        for i, choice in enumerate(self.choices):
+            marker = "*" if i == default else " "
+            print(f"  {marker}[{i}] {choice}")
+        raw = input(f"Selection (default {default}): ").strip()
+        if not raw:
+            return default
+        try:
+            idx = int(raw)
+        except ValueError:
+            # accept the choice text itself
+            if raw in self.choices:
+                return self.choices.index(raw)
+            print(f"  -> {raw!r} not in {self.choices}, keeping {self.choices[default]!r}")
+            return default
+        if 0 <= idx < len(self.choices):
+            return idx
+        print(f"  -> {idx} out of range, keeping {self.choices[default]!r}")
+        return default
+
+    def run(self, default: int = 0) -> int:
+        if sys.stdin.isatty() and sys.stdout.isatty():
+            try:
+                return self._run_tty(default)
+            except (ImportError, OSError):  # pragma: no cover - exotic ttys
+                pass
+        return self._run_plain(default)
+
+
+def choose(prompt: str, choices, default):
+    """Menu-select a VALUE from ``choices`` with ``default`` preselected."""
+    choices = list(choices)
+    idx = choices.index(default) if default in choices else 0
+    return choices[BulletMenu(prompt, choices).run(idx)]

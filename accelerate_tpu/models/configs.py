@@ -208,6 +208,12 @@ class EncoderConfig:
     fp8_recipe: str = "current"
     fp8_amax_history_len: int = 16
 
+    def __post_init__(self):
+        if self.fp8_recipe not in ("current", "delayed"):
+            raise ValueError(
+                f"fp8_recipe must be 'current' or 'delayed', got {self.fp8_recipe!r}"
+            )
+
     @classmethod
     def tiny(cls, **kw):
         kw.setdefault("vocab_size", 256)

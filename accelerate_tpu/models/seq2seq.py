@@ -80,6 +80,10 @@ class Seq2SeqConfig:
     fp8_amax_history_len: int = 16
 
     def __post_init__(self):
+        if self.fp8_recipe not in ("current", "delayed"):
+            raise ValueError(
+                f"fp8_recipe must be 'current' or 'delayed', got {self.fp8_recipe!r}"
+            )
         if self.num_decoder_layers is None:
             self.num_decoder_layers = self.num_layers
         if self.max_cache_len is None:

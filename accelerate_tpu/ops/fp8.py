@@ -145,12 +145,9 @@ def _fp8_scales_fwd(a, b, sa, sb):
 
 
 def _fp8_scales_bwd(res, g):
-    a, b = res
-    da = _scaled_dot(g, b.T, jnp.float8_e5m2, E5M2_MAX, jnp.float8_e4m3fn, E4M3_MAX, a.dtype)
-    a2 = a.reshape(-1, a.shape[-1])
-    g2 = g.reshape(-1, g.shape[-1])
-    db = _scaled_dot(a2.T, g2, jnp.float8_e4m3fn, E4M3_MAX, jnp.float8_e5m2, E5M2_MAX, b.dtype)
-    return da.reshape(a.shape), db, None, None
+    # same gradient recipe as the current-scaling path — one implementation
+    da, db = _fp8_dot_bwd(res, g)
+    return da, db, None, None
 
 
 _fp8_dot_with_scales.defvjp(_fp8_scales_fwd, _fp8_scales_bwd)

@@ -315,3 +315,85 @@ class TestElasticLaunch:
         script.write_text("import sys; sys.exit(7)\n")
         r = _run(["launch", "--cpu", "--num_processes", "2", "--max_restarts", "1", str(script)])
         assert r.returncode == 7
+
+
+class TestConfigMenu:
+    """The arrow-key BulletMenu (reference commands/menu/ parity) and its
+    non-TTY fallback used by `accelerate-tpu config`."""
+
+    def test_plain_fallback_default_and_index(self, monkeypatch):
+        import io
+
+        from accelerate_tpu.commands.menu import BulletMenu, choose
+
+        monkeypatch.setattr("sys.stdin", io.StringIO("\n"))
+        assert BulletMenu("pick", ["a", "b", "c"])._run_plain(1) == 1
+        monkeypatch.setattr("sys.stdin", io.StringIO("2\n"))
+        assert BulletMenu("pick", ["a", "b", "c"])._run_plain(0) == 2
+        # choice text accepted; out-of-range falls back to default
+        monkeypatch.setattr("sys.stdin", io.StringIO("b\n"))
+        assert choose("pick", ["a", "b", "c"], "a") == "b"
+        monkeypatch.setattr("sys.stdin", io.StringIO("9\n"))
+        assert BulletMenu("pick", ["a", "b"])._run_plain(0) == 0
+
+    def test_tty_arrow_navigation(self):
+        """Drive the raw-mode path on a real pty: down, down, enter. A fresh
+        subprocess owns the slave end — forking out of the live-JAX pytest
+        process would inherit XLA threads and deadlock."""
+        import os
+        import pty
+        import subprocess
+        import sys
+        import time
+
+        master, slave = pty.openpty()
+        child = subprocess.Popen(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, %r);"
+             "from accelerate_tpu.commands.menu import BulletMenu;"
+             "idx = BulletMenu('pick', ['a', 'b', 'c']).run(0);"
+             "import os; os.write(2, f'RESULT={idx}'.encode())" % REPO],
+            stdin=slave, stdout=slave, stderr=subprocess.PIPE, close_fds=True,
+        )
+        os.close(slave)
+        time.sleep(1.0)
+        os.write(master, b"\x1b[B\x1b[B\r")
+        try:
+            _, err = child.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            _, err = child.communicate()
+            raise AssertionError(f"menu child hung: {err.decode(errors='replace')}")
+        os.close(master)
+        assert child.returncode == 0, err.decode(errors="replace")
+        assert b"RESULT=2" in err, err.decode(errors="replace")
+
+    def test_config_command_noninteractive(self, tmp_path, monkeypatch):
+        """The questionnaire end-to-end with piped answers (non-TTY path)."""
+        import io
+
+        from accelerate_tpu.commands import config as config_cmd
+
+        answers = "\n".join([
+            "0",    # compute environment -> LOCAL_MACHINE
+            "2",    # num processes
+            "2",    # mixed precision -> bf16
+            "2",    # sharding strategy -> FSDP
+            "4",    # fsdp degree
+            "1",    # tensor parallel
+            "1",    # sequence parallel
+            "-1",   # data parallel
+        ]) + "\n"
+        monkeypatch.setattr("sys.stdin", io.StringIO(answers))
+
+        class Args:
+            config_file = str(tmp_path / "cfg.yaml")
+
+        assert config_cmd.config_command(Args()) == 0
+        import yaml
+
+        data = yaml.safe_load(open(Args.config_file))
+        assert data["num_processes"] == 2
+        assert data["mixed_precision"] == "bf16"
+        assert data["sharding_strategy"] == "FSDP"
+        assert data["fsdp"] == 4
