@@ -23,7 +23,10 @@ def _read_key(fd: int) -> str:
     the escape sequence's continuation bytes into Python's buffer."""
     import select
 
-    ch = os.read(fd, 1).decode(errors="replace")
+    raw = os.read(fd, 1)
+    if not raw:  # EOF/hangup: some ptys return b"" instead of raising EIO
+        raise EOFError("tty input closed")
+    ch = raw.decode(errors="replace")
     if ch == "\x1b":
         # Only consume continuation bytes that are ALREADY pending: a lone
         # ESC press must not swallow the user's next keystroke (or block).
@@ -66,7 +69,10 @@ class BulletMenu:
         fd = sys.stdin.fileno()
         old = termios.tcgetattr(fd)
         try:
-            tty.setcbreak(fd)
+            # TCSADRAIN, not the default TCSAFLUSH: keystrokes typed (or
+            # piped by a test harness) before the menu finished starting
+            # must not be discarded
+            tty.setcbreak(fd, termios.TCSADRAIN)
             while True:
                 key = _read_key(fd)
                 if key in _UP:
@@ -107,8 +113,14 @@ class BulletMenu:
     def run(self, default: int = 0) -> int:
         if sys.stdin.isatty() and sys.stdout.isatty():
             try:
+                import termios as _termios
+
+                tty_errors = (ImportError, OSError, EOFError, _termios.error)
+            except ImportError:  # pragma: no cover - non-unix
+                tty_errors = (ImportError, OSError, EOFError)
+            try:
                 return self._run_tty(default)
-            except (ImportError, OSError):  # pragma: no cover - exotic ttys
+            except tty_errors:  # pragma: no cover - exotic/hung-up ttys
                 pass
         return self._run_plain(default)
 

@@ -356,7 +356,16 @@ class TestConfigMenu:
             stdin=slave, stdout=slave, stderr=subprocess.PIPE, close_fds=True,
         )
         os.close(slave)
-        time.sleep(1.0)
+        # wait for the menu prompt before typing (a fixed sleep raced the
+        # child's jax import on cold caches)
+        seen = b""
+        deadline = time.time() + 60
+        while b"pick" not in seen and time.time() < deadline:
+            import select
+
+            if select.select([master], [], [], 1.0)[0]:
+                seen += os.read(master, 1024)
+        assert b"pick" in seen, seen.decode(errors="replace")
         os.write(master, b"\x1b[B\x1b[B\r")
         try:
             _, err = child.communicate(timeout=60)

@@ -164,6 +164,16 @@ class TestExamplesRun:
         assert r.returncode == 0, r.stderr
         assert "distributed generation done" in r.stdout
 
+    def test_inference_distributed_seq2seq_example(self):
+        r = _run_inference_example(os.path.join("inference", "distributed_seq2seq.py"))
+        assert r.returncode == 0, r.stderr
+        assert "generated" in r.stdout
+
+    def test_inference_tensor_parallel_example(self):
+        r = _run_inference_example(os.path.join("inference", "tensor_parallel.py"))
+        assert r.returncode == 0, r.stderr
+        assert "tensor-parallel generation" in r.stdout
+
     def test_inference_pippy_example(self):
         r = _run_inference_example(os.path.join("inference", "pippy.py"))
         assert r.returncode == 0, r.stderr
