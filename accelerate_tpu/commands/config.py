@@ -26,7 +26,11 @@ def register(subparsers):
 
 
 def _ask(question: str, default, cast=str):
-    raw = input(f"{question} ({default}): ").strip()
+    try:
+        raw = input(f"{question} ({default}): ").strip()
+    except EOFError:  # closed/hung-up stdin: take the default
+        print()
+        return default
     if not raw:
         return default
     return cast(raw)

@@ -94,7 +94,12 @@ class BulletMenu:
         for i, choice in enumerate(self.choices):
             marker = "*" if i == default else " "
             print(f"  {marker}[{i}] {choice}")
-        raw = input(f"Selection (default {default}): ").strip()
+        try:
+            raw = input(f"Selection (default {default}): ").strip()
+        except EOFError:
+            # closed/hung-up stdin: take the default rather than crashing
+            print()
+            return default
         if not raw:
             return default
         try:

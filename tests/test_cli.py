@@ -358,14 +358,24 @@ class TestConfigMenu:
         os.close(slave)
         # wait for the menu prompt before typing (a fixed sleep raced the
         # child's jax import on cold caches)
+        import select
+
         seen = b""
         deadline = time.time() + 60
         while b"pick" not in seen and time.time() < deadline:
-            import select
-
             if select.select([master], [], [], 1.0)[0]:
-                seen += os.read(master, 1024)
-        assert b"pick" in seen, seen.decode(errors="replace")
+                try:
+                    chunk = os.read(master, 1024)
+                except OSError:  # EIO: child died before printing the prompt
+                    break
+                if not chunk:
+                    break
+                seen += chunk
+        assert b"pick" in seen, (
+            seen.decode(errors="replace")
+            + child.stderr.read().decode(errors="replace")
+            if child.poll() is not None else seen.decode(errors="replace")
+        )
         os.write(master, b"\x1b[B\x1b[B\r")
         try:
             _, err = child.communicate(timeout=60)
