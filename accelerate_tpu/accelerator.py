@@ -298,16 +298,24 @@ class TrainEngine:
         if self._manual_vag is not None and not extra_state:
             ids, labels = _extract_lm_batch(args, kwargs)
             if labels is not None:
-                loss, grads = self._manual_vag(self._cast_params(params), ids, labels)
+                # scale seeds the manual backward (scaled-domain grads, same
+                # underflow protection as the AD path below), then unscale
+                # before the finite check
+                loss, grads = self._manual_vag(
+                    self._cast_params(params), ids, labels, scale=scale
+                )
                 loss = loss.astype(jnp.float32)
-                grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
                 if scale is not None:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: (g.astype(jnp.float32) / scale), grads
+                    )
                     finite = jnp.all(
                         jnp.asarray(
                             [jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)]
                         )
                     )
                 else:
+                    grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
                     finite = jnp.asarray(True)
                 return {"loss": loss}, extra_state, grads, finite, loss
 
@@ -699,13 +707,13 @@ class TrainEngine:
                 args, kwargs = _batch_to_call(mb)
                 ids, labels = _extract_lm_batch(args, kwargs)
                 if manual_vag is not None and not es and labels is not None:
-                    # model-owned backward schedule (1f1b pipeline): grads
-                    # come unscaled; re-scale so the post-scan /scale and
-                    # finite check see the same convention as the AD path
-                    l, g = manual_vag(self._cast_params(params), ids, labels)
+                    # model-owned backward schedule (1f1b pipeline): the loss
+                    # scale seeds the manual backward's cotangent, so the
+                    # whole backward runs scaled (fp16 underflow protection,
+                    # same as AD) and grads arrive scaled for the post-scan
+                    # /scale + finite check
+                    l, g = manual_vag(self._cast_params(params), ids, labels, scale=scale)
                     l = l.astype(jnp.float32)
-                    if scale is not None:
-                        g = jax.tree_util.tree_map(lambda x: x * scale, g)
                     new_es = es
                 else:
 

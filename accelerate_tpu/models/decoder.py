@@ -470,7 +470,11 @@ class DecoderLM(nn.Module):
         else:
             cfg_staged = dataclasses.replace(cfg, pipeline_stages=num_stages)
 
-        def value_and_grad(params, input_ids, labels):
+        def value_and_grad(params, input_ids, labels, scale=None):
+            # ``scale`` (fp16 loss scale) seeds the head-vjp cotangent so the
+            # whole manual backward — head, stages, embedding — runs in the
+            # scaled domain, matching AD's underflow protection. Grads are
+            # returned SCALED; the caller divides by ``scale`` afterwards.
             b, s = input_ids.shape
             M = _adapt_microbatches(
                 b, cfg_staged.pipeline_microbatches or num_stages, num_stages
@@ -506,7 +510,10 @@ class DecoderLM(nn.Module):
                     ),
                     outer, y,
                 )
-                douter_h, dy = vjp(jnp.ones((), loss_m.dtype))
+                seed = jnp.ones((), loss_m.dtype)
+                if scale is not None:
+                    seed = seed * jnp.asarray(scale, loss_m.dtype)
+                douter_h, dy = vjp(seed)
                 # fp32 accumulators: the scheduler sums aux over M microbatches
                 douter_h = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.float32), douter_h

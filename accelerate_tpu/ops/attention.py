@@ -605,8 +605,22 @@ def dot_product_attention(
     ``bias`` falls back to XLA (the kernel implements masks, not biases)."""
     if impl == "flash" and bias is not None:
         raise ValueError("flash impl does not support arbitrary bias; use kv_mask/segment_ids or impl='xla'")
+
+    def _fold_masks_into_bias(bias):
+        # Masks must survive on every path — the XLA fallback honors them by
+        # folding into the additive bias (padding keys get -inf logits).
+        if kv_mask is None and q_segment_ids is None:
+            return bias
+        bias_parts = [] if bias is None else [bias]
+        if kv_mask is not None:
+            bias_parts.append(jnp.where(kv_mask[:, None, None, :] != 0, 0.0, NEG_INF))
+        if q_segment_ids is not None:
+            same = q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
+            bias_parts.append(jnp.where(same, 0.0, NEG_INF))
+        return sum(bias_parts)
+
     if impl == "xla" or bias is not None:
-        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale, bias=_fold_masks_into_bias(bias))
     on_tpu = jax.default_backend() == "tpu"
     blocks_ok = (
         _pick_block(q.shape[2], 1024) and _pick_block(k.shape[2], 1024) and q.shape[-1] % 128 == 0
@@ -617,12 +631,4 @@ def dot_product_attention(
             kv_mask=kv_mask, q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
             interpret=interpret or not on_tpu,
         )
-    if kv_mask is not None or q_segment_ids is not None:
-        bias_parts = []
-        if kv_mask is not None:
-            bias_parts.append(jnp.where(kv_mask[:, None, None, :] != 0, 0.0, NEG_INF))
-        if q_segment_ids is not None:
-            same = q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
-            bias_parts.append(jnp.where(same, 0.0, NEG_INF))
-        bias = sum(bias_parts)
-    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale, bias=bias)
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale, bias=_fold_masks_into_bias(bias))

@@ -170,6 +170,45 @@ class TestFlashAttention:
         ref = mha_reference(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.parametrize("with_bias", [False, True])
+    def test_xla_impl_honors_kv_mask(self, with_bias):
+        # regression (advisor r4): impl="xla" used to early-return before the
+        # kv_mask->bias conversion, silently attending over padding keys —
+        # wrong Seq2SeqLM cross-attention under attention_impl="xla"
+        from accelerate_tpu.ops.attention import NEG_INF
+
+        q, k, v = _rand_qkv(jax.random.PRNGKey(11), b=2, s=256)
+        kv_mask = jnp.asarray(
+            (np.arange(256)[None, :] < np.array([[192], [128]])).astype(np.int32)
+        )
+        mask_bias = jnp.where(kv_mask[:, None, None, :] != 0, 0.0, NEG_INF)
+        extra = (
+            0.1 * jax.random.normal(jax.random.PRNGKey(12), (2, 1, 256, 256))
+            if with_bias
+            else None
+        )
+        out = dot_product_attention(
+            q, k, v, kv_mask=kv_mask, bias=extra, impl="xla"
+        )
+        ref_bias = mask_bias if extra is None else mask_bias + extra
+        ref = mha_reference(q, k, v, bias=ref_bias)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+        # and the masked rows actually differ from the unmasked computation
+        unmasked = mha_reference(q, k, v, bias=extra)
+        assert np.abs(np.asarray(out) - np.asarray(unmasked)).max() > 1e-3
+
+    def test_xla_impl_honors_segment_ids(self):
+        from accelerate_tpu.ops.attention import NEG_INF
+
+        q, k, v = _rand_qkv(jax.random.PRNGKey(13), b=1, s=256)
+        seg = jnp.asarray((np.arange(256) >= 128).astype(np.int32))[None, :]
+        out = dot_product_attention(
+            q, k, v, q_segment_ids=seg, kv_segment_ids=seg, impl="xla"
+        )
+        same = seg[:, None, :, None] == seg[:, None, None, :]
+        ref = mha_reference(q, k, v, bias=jnp.where(same, 0.0, NEG_INF))
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
 
 class TestLayers:
     def test_rms_norm_matches_manual(self):

@@ -331,6 +331,35 @@ class TestOneFOneB:
             err = np.abs(a - b).max() / (np.abs(a).max() + 1e-8)
             assert err < 2e-4, (k, err)
 
+    def test_1f1b_loss_scale_seeds_backward(self):
+        """fp16 loss scaling must run the MANUAL backward in the scaled
+        domain (advisor r4): vag(..., scale=s) returns s * vag(...) grads and
+        an unchanged loss."""
+        import dataclasses
+
+        from accelerate_tpu.parallel.sharding import unbox_params
+
+        cfg = dataclasses.replace(
+            _cfg(num_layers=4), pipeline_stages=2, pipeline_microbatches=2,
+            pipeline_schedule="1f1b", remat=False, dtype=jnp.float32,
+        )
+        model = DecoderLM(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(7), (4, 16), 0, cfg.vocab_size)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32))
+        params, _ = unbox_params(variables["params"])
+        vag = model.pipeline_value_and_grad()
+        assert vag is not None
+
+        l0, g0 = jax.jit(vag)(params, ids, ids)
+        s = jnp.asarray(512.0, jnp.float32)
+        l1, g1 = jax.jit(lambda p, i, t: vag(p, i, t, scale=s))(params, ids, ids)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        f0, f1 = _flat(g0), _flat(g1)
+        for k in f0:
+            np.testing.assert_allclose(
+                np.asarray(f1[k]), 512.0 * np.asarray(f0[k]), rtol=1e-4, atol=1e-6
+            )
+
     def test_decoder_1f1b_matches_gpipe_with_uneven_ignore_padding(self):
         """Loss is the GLOBAL mean over non-ignored tokens in both schedules:
         per-microbatch means must be valid-token-share weighted, or uneven
