@@ -300,9 +300,12 @@ class TrainEngine:
             if labels is not None:
                 # scale seeds the manual backward (scaled-domain grads, same
                 # underflow protection as the AD path below), then unscale
-                # before the finite check
+                # before the finite check. scale= is passed only when loss
+                # scaling is on: the hook is duck-typed, and a 3-arg
+                # implementation keeps working without fp16.
                 loss, grads = self._manual_vag(
-                    self._cast_params(params), ids, labels, scale=scale
+                    self._cast_params(params), ids, labels,
+                    **({"scale": scale} if scale is not None else {}),
                 )
                 loss = loss.astype(jnp.float32)
                 if scale is not None:
@@ -665,9 +668,22 @@ class TrainEngine:
     # fully-fused train step (the perf path)
     # ------------------------------------------------------------------
 
-    def build_train_step(self, loss_fn: Optional[Callable] = None, micro_steps: Optional[int] = None):
+    def build_train_step(
+        self,
+        loss_fn: Optional[Callable] = None,
+        micro_steps: Optional[int] = None,
+        steps_per_call: Optional[int] = None,
+    ):
         """One jit: split batch into micro-batches, lax.scan fwd+bwd
-        accumulating grads, clip, update. Returns step(batch)->metrics."""
+        accumulating grads, clip, update. Returns step(batch)->metrics.
+
+        ``steps_per_call=K`` fuses K FULL optimizer steps (each with its own
+        batch and RNG stream) into ONE executable via lax.scan — the
+        MaxText-style train loop. The returned runner then takes a batch
+        whose leaves carry a leading [K, ...] axis (K stacked per-step
+        batches) and returns the LAST step's metrics plus ``loss_mean`` over
+        the K steps. This amortizes per-dispatch host latency, which
+        dominates for sub-50ms steps on remote-attached runtimes."""
         micro = micro_steps or self.gradient_state.num_steps
         if (
             (
@@ -677,6 +693,11 @@ class TrainEngine:
             and self.mesh is not None
             and self.mesh.shape.get("replica", 1) > 1
         ):
+            if steps_per_call and steps_per_call > 1:
+                raise NotImplementedError(
+                    "steps_per_call>1 is not supported together with gradient "
+                    "compression (the compressed step runs under shard_map)"
+                )
             return self._build_compressed_replica_step(loss_fn, micro)
         user_loss = loss_fn
         max_norm = self._clip_max_norm
@@ -711,8 +732,12 @@ class TrainEngine:
                     # scale seeds the manual backward's cotangent, so the
                     # whole backward runs scaled (fp16 underflow protection,
                     # same as AD) and grads arrive scaled for the post-scan
-                    # /scale + finite check
-                    l, g = manual_vag(self._cast_params(params), ids, labels, scale=scale)
+                    # /scale + finite check. scale= only when scaling is on
+                    # (duck-typed hook: 3-arg implementations stay valid).
+                    l, g = manual_vag(
+                        self._cast_params(params), ids, labels,
+                        **({"scale": scale} if scale is not None else {}),
+                    )
                     l = l.astype(jnp.float32)
                     new_es = es
                 else:
@@ -750,7 +775,26 @@ class TrainEngine:
             metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
             return new_params, new_opt, new_extra, new_scale, skipped, metrics
 
-        jitted = jax.jit(step_fn, donate_argnums=(0, 1) if self.donate_state else ())
+        if steps_per_call and steps_per_call > 1:
+
+            def multi_fn(params, opt_state, extra_state, scale_state, rng_key, batches):
+                def body(carry, mb):
+                    p, o, es, ss, key = carry
+                    key, sub = jax.random.split(key)
+                    p, o, es, ss, skipped, metrics = step_fn(p, o, es, ss, sub, mb)
+                    return (p, o, es, ss, key), (metrics, skipped)
+
+                (p, o, es, ss, _), (ms, sk) = jax.lax.scan(
+                    body, (params, opt_state, extra_state, scale_state, rng_key), batches
+                )
+                metrics = jax.tree_util.tree_map(lambda x: x[-1], ms)
+                metrics["loss_mean"] = jnp.mean(ms["loss"])
+                return p, o, es, ss, sk[-1], metrics
+
+            fused_fn = multi_fn
+        else:
+            fused_fn = step_fn
+        jitted = jax.jit(fused_fn, donate_argnums=(0, 1) if self.donate_state else ())
 
         def run(batch):
             rng_key = default_keychain().next_key("train_step")
@@ -766,7 +810,7 @@ class TrainEngine:
             if self.scale_state is not None:
                 self.scale_state = new_scale
                 self._last_skipped = skipped
-            self.step_count += 1
+            self.step_count += steps_per_call if steps_per_call else 1
             return metrics
 
         return run
@@ -1538,13 +1582,22 @@ class Accelerator:
         property of the staged computation, so nothing to switch here."""
         yield
 
-    def build_train_step(self, loss_fn: Optional[Callable] = None, micro_steps: Optional[int] = None):
+    def build_train_step(
+        self,
+        loss_fn: Optional[Callable] = None,
+        micro_steps: Optional[int] = None,
+        steps_per_call: Optional[int] = None,
+    ):
         """The fused-perf path: one XLA computation for the whole optimizer
         step (micro-batch scan + clip + update). Idiomatic-JAX users should
-        prefer this over the eager-parity loop."""
+        prefer this over the eager-parity loop. ``steps_per_call=K`` scans K
+        full optimizer steps in one executable (batch leaves gain a leading
+        [K, ...] axis) — amortizes per-dispatch latency for small models."""
         if not self._engines:
             raise RuntimeError("prepare(model, optimizer) before build_train_step")
-        return self._engines[-1].build_train_step(loss_fn=loss_fn, micro_steps=micro_steps)
+        return self._engines[-1].build_train_step(
+            loss_fn=loss_fn, micro_steps=micro_steps, steps_per_call=steps_per_call
+        )
 
     # ------------------------------------------------------------------
     # collectives façade (reference accelerator.py:2408-2608)
@@ -1587,11 +1640,14 @@ class Accelerator:
             return model.unwrap()
         return model
 
-    def prepare_for_eval(self, batch):
-        """Place an eval batch the same way prepared dataloaders do."""
+    def prepare_for_eval(self, batch, batch_dim: int = 0):
+        """Place an eval batch the same way prepared dataloaders do.
+        ``batch_dim=1`` for a stacked [K, batch, ...] multi-step batch
+        (``build_train_step(steps_per_call=K)``): steps axis replicated,
+        batch axis sharded over the data mesh axes."""
         from .utils.operations import make_global_batch
 
-        return make_global_batch(batch, self.state.mesh)
+        return make_global_batch(batch, self.state.mesh, batch_dim=batch_dim)
 
     # ------------------------------------------------------------------
     # trigger (coordinated breakpoint; reference accelerator.py:2198-2255)

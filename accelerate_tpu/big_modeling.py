@@ -21,6 +21,7 @@ overlaps with compute.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Mapping, Optional
 
 import jax
@@ -225,30 +226,102 @@ class DispatchedModel:
                 out[path] = jax.ShapeDtypeStruct(shape, dtype, sharding=pinned)
         return unflatten_to_like(out, self.params)
 
+    def _export_cache_path(self, key, aval_key, static_args, static_kw, abstract):
+        """Disk path for the serialized jax.export artifact of this AOT
+        program, or None when the persistent cache is disabled. The key
+        hashes everything the traced program depends on: model definition
+        (flax repr includes the config), placements, param avals+shardings,
+        call avals, statics, and the jax version."""
+        import hashlib
+
+        from .utils.compile_cache import ensure_persistent_compile_cache
+
+        base = ensure_persistent_compile_cache()
+        if base is None:
+            return None
+        mat = repr((
+            jax.__version__,
+            repr(self.definition),
+            key,
+            aval_key,
+            static_args,
+            sorted(static_kw.items()) if isinstance(static_kw, dict) else static_kw,
+            [
+                (p, str(l.shape), str(l.dtype), str(getattr(l, "sharding", None)))
+                for p, l in sorted(flatten_pytree(abstract).items())
+            ],
+        ))
+        h = hashlib.sha256(mat.encode()).hexdigest()[:32]
+        d = os.path.join(base, "exports")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"dispatch-{h}.jaxexport")
+
     def aot_compile(self, *args, **kwargs):
         """Ahead-of-time compile the placed apply for these example args
         (shapes/dtypes only — values ignored). Runs in the calling thread, so
         ``load_checkpoint_and_dispatch`` overlaps it with checkpoint
         streaming; with the persistent compile cache on, the executable also
-        serves every later process. Returns self."""
-        from .accelerator import _split_static_call
-        from .utils.compile_cache import ensure_persistent_compile_cache
+        serves every later process. Returns self.
 
-        ensure_persistent_compile_cache()
+        Two-level persistence: the XLA cache skips backend compilation, and a
+        ``jax.export`` artifact on disk skips the Python TRACE of the model —
+        which is the part a fresh process otherwise pays ~2 s of sole-core
+        CPU for during dispatch. A cache-hit process deserializes StableHLO
+        and compiles it (hitting the XLA cache), never running model code."""
+        from .accelerator import _split_static_call
+
         traced_args, static_args, traced_kw, static_kw = _split_static_call(args, kwargs)
         key = self._placement_key()
-        _, jitted = self._apply_for(key)
         abstract = self._abstract_params()
         to_aval = lambda t: jax.tree_util.tree_map(
             lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l)), t
         )
         a_args, a_kw = to_aval(traced_args), to_aval(traced_kw)
-        compiled = jitted.lower(abstract, a_args, a_kw, static_args, static_kw).compile()
+        aot_key = (key, self._aval_key((a_args, a_kw)), static_args, static_kw)
+        cache_path = self._export_cache_path(
+            key, aot_key[1], static_args, static_kw, abstract
+        )
+
+        compiled = None
+        if cache_path is not None and os.path.exists(cache_path):
+            try:
+                from jax import export as jax_export
+
+                with open(cache_path, "rb") as f:
+                    exp = jax_export.deserialize(bytearray(f.read()))
+                # cache the COMPILED AOT object (XLA-cache-served), not the
+                # jit wrapper: a wrapper would re-trace on first __call__ and
+                # silently recompile on placement drift instead of raising
+                # into the documented jit fallback
+                compiled = jax.jit(exp.call).lower(abstract, a_args, a_kw).compile()
+            except Exception:  # stale/incompatible artifact — retrace below
+                compiled = None
+        if compiled is None and cache_path is not None:
+            # trace ONCE through export: serialize for future processes, and
+            # compile this process's executable from the same StableHLO
+            try:
+                from jax import export as jax_export
+
+                def _bound(p, a, kw):
+                    apply, _ = self._apply_for(key)
+                    return apply(p, a, kw, static_args, static_kw)
+
+                exp = jax_export.export(jax.jit(_bound))(abstract, a_args, a_kw)
+                tmp = cache_path + f".tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(exp.serialize())
+                os.replace(tmp, cache_path)
+                compiled = jax.jit(exp.call).lower(abstract, a_args, a_kw).compile()
+            except Exception:  # best-effort: export has feature gaps
+                compiled = None
+        if compiled is None:
+            _, jitted = self._apply_for(key)
+            compiled = jitted.lower(abstract, a_args, a_kw, static_args, static_kw).compile()
         # params avals are excluded from the key: they are determined by the
         # placement key, and walking every param leaf per call would put
         # O(num_params) Python work on the dispatch hot path; a placement
         # drift surfaces as TypeError/ValueError and falls back to jit
-        self._aot[(key, self._aval_key((a_args, a_kw)), static_args, static_kw)] = compiled
+        self._aot[aot_key] = compiled
         return self
 
     def __call__(self, *args, **kwargs):

@@ -32,15 +32,53 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
+import importlib
 
-try:  # pallas TPU backend is absent on some CPU-only jaxlib builds
-    from jax.experimental.pallas import tpu as pltpu
 
-    _HAS_PLTPU = True
-except Exception:  # pragma: no cover
-    pltpu = None
-    _HAS_PLTPU = False
+class _LazyModule:
+    """Deferred import: pallas costs ~0.2 s at import time, which lands on
+    every process's startup (the TTFT bench counts it) even when the process
+    never traces a kernel. Resolution happens at first attribute access —
+    i.e. at trace time, inside the first jit."""
+
+    def __init__(self, name):
+        self._name = name
+        self._mod = None
+
+    def _resolve(self):
+        if self._mod is None:
+            self._mod = importlib.import_module(self._name)
+        return self._mod
+
+    def __getattr__(self, attr):
+        return getattr(self._resolve(), attr)
+
+
+pl = _LazyModule("jax.experimental.pallas")
+_pltpu_lazy = _LazyModule("jax.experimental.pallas.tpu")
+
+
+class _PltpuProxy:
+    """pallas TPU backend is absent on some CPU-only jaxlib builds; probe
+    lazily. Truthiness mirrors availability so `if pltpu:` keeps the old
+    None semantics."""
+
+    def __getattr__(self, attr):
+        return getattr(_pltpu_lazy._resolve(), attr)
+
+    def __bool__(self):
+        return _has_pltpu()
+
+
+pltpu = _PltpuProxy()
+
+
+def _has_pltpu() -> bool:
+    try:
+        _pltpu_lazy._resolve()
+        return True
+    except Exception:  # pragma: no cover
+        return False
 
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() semantics with no NaN risk
 
@@ -311,7 +349,7 @@ def _pick_block(s: int, preferred: int) -> int:
 
 def _grid_params(interpret: bool):
     kw = {"interpret": interpret}
-    if _HAS_PLTPU and not interpret:
+    if not interpret and _has_pltpu():
         kw["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         )
@@ -454,7 +492,7 @@ def _flash_bwd_call(q, k, v, out, lse, do, masks, causal, sm_scale, bq, bk, inte
 
 
 def _vmem(shape):
-    if not _HAS_PLTPU:  # pragma: no cover
+    if not _has_pltpu():  # pragma: no cover
         raise RuntimeError("pallas TPU memory spaces unavailable in this jaxlib build")
     return pltpu.VMEM(shape, jnp.float32)
 

@@ -147,16 +147,24 @@ def send_to_device(data, device_or_sharding, non_blocking: bool = False, skip_ke
     return jax.tree_util.tree_map(_put, data)
 
 
-def make_global_batch(data, mesh: Mesh, batch_axes=("replica", "data", "fsdp")):
+def make_global_batch(
+    data, mesh: Mesh, batch_axes=("replica", "data", "fsdp"), batch_dim: int = 0
+):
     """Per-host local batch → global jax.Array sharded batch-dim over the
     data axes (the TPU-native DataLoaderShard device-placement step;
     replaces reference data_loader.py:566's `.to(device)`).
 
     Uses `jax.make_array_from_process_local_data` so each host contributes
-    only its local shard — no cross-host traffic.
+    only its local shard — no cross-host traffic. ``batch_dim=1`` places a
+    stacked [K, batch, ...] multi-step batch (build_train_step's
+    steps_per_call): the steps axis is replicated, the batch axis sharded.
     """
     batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
-    sharding = NamedSharding(mesh, P(batch_axes))
+    sharding = NamedSharding(mesh, P(*([None] * batch_dim), batch_axes))
+    # leaves too low-rank to carry the batch dim (e.g. a [K] per-step scalar
+    # in a stacked multi-step batch) replicate instead of taking a spec
+    # whose rank exceeds theirs
+    replicated = NamedSharding(mesh, P())
     shard_degree = int(np.prod([mesh.shape[a] for a in batch_axes])) if batch_axes else 1
     data = convert_to_jax(data)
 
@@ -164,12 +172,17 @@ def make_global_batch(data, mesh: Mesh, batch_axes=("replica", "data", "fsdp")):
         if not is_array_like(x):
             return x
         x = np.asarray(x)
+        if x.ndim <= batch_dim:
+            nproc1 = jax.process_count()
+            if nproc1 == 1:
+                return jax.device_put(x, replicated)
+            return jax.make_array_from_process_local_data(replicated, x)
         nproc = jax.process_count()
-        global_rows = x.shape[0] * nproc if x.ndim >= 1 else None
-        if global_rows is not None and global_rows % shard_degree != 0:
+        global_rows = x.shape[batch_dim] * nproc
+        if global_rows % shard_degree != 0:
             raise ValueError(
                 f"global batch dimension {global_rows} (= per-process "
-                f"{x.shape[0]} x {nproc} processes) is not divisible by the "
+                f"{x.shape[batch_dim]} x {nproc} processes) is not divisible by the "
                 f"data-sharding degree {shard_degree} (mesh axes {batch_axes}). "
                 "Pick a per-process batch size so that batch_size * num_processes "
                 "is a multiple of the data/fsdp mesh axes product."

@@ -144,6 +144,59 @@ def _qw_unflatten(aux, children):
 jax.tree_util.register_pytree_node(QuantizedWeight, _qw_flatten, _qw_unflatten)
 
 
+def _register_export_serialization():
+    """Make the quantized pytree nodes serializable by jax.export — the
+    dispatch path persists its AOT program as a StableHLO artifact so later
+    processes skip the model trace; that serialization walks the params
+    treedef, which contains these nodes."""
+    import json
+
+    try:
+        from jax import export as jax_export
+
+        reg = jax_export.register_pytree_node_serialization
+    except Exception:  # pragma: no cover - old jax without the API
+        return
+
+    def _qs_ser(aux):
+        (shape,) = aux
+        return json.dumps({"shape": list(shape)}).encode()
+
+    def _qs_de(b):
+        d = json.loads(b.decode())
+        return (tuple(d["shape"]),)
+
+    def _qw_ser(aux):
+        shape, bits, group, dtype, qtype = aux
+        return json.dumps({
+            "shape": list(shape), "bits": bits, "group": group,
+            "dtype": np.dtype(dtype).name, "qtype": qtype,
+        }).encode()
+
+    def _qw_de(b):
+        d = json.loads(b.decode())
+        return (tuple(d["shape"]), d["bits"], d["group"], np.dtype(d["dtype"]), d["qtype"])
+
+    try:
+        reg(
+            QuantizedScale,
+            serialized_name="accelerate_tpu.QuantizedScale",
+            serialize_auxdata=_qs_ser,
+            deserialize_auxdata=_qs_de,
+        )
+        reg(
+            QuantizedWeight,
+            serialized_name="accelerate_tpu.QuantizedWeight",
+            serialize_auxdata=_qw_ser,
+            deserialize_auxdata=_qw_de,
+        )
+    except Exception:  # pragma: no cover - double registration
+        pass
+
+
+_register_export_serialization()
+
+
 def quantize_array(w, bits: int = 8, group_size: int = 128,
                    qtype: str = "linear", double_quant: bool = False) -> QuantizedWeight:
     """Per-group quantization of a [K, ...] float array along dim 0.
@@ -192,15 +245,17 @@ def quantize_array_host(
     else:
         w32 = np.asarray(w, np.float32).reshape(k // g, g, *w.shape[1:])
         amax = np.max(np.abs(w32), axis=1, keepdims=True)
+        # reciprocal-MULTIPLY (not fdiv), matching the native kernel bit for
+        # bit — and XLA-on-TPU semantics, which lowers fdiv the same way
         if qtype == "nf4":
             scale = np.where(amax > 0, amax, 1.0).astype(np.float32)
-            normed = w32 / scale
+            normed = w32 * (np.float32(1.0) / scale)
             # nearest NF4 level via the midpoint boundaries (the code is sorted)
             q = np.searchsorted(_NF4_MIDPOINTS, normed).astype(np.int8)
         else:
             qmax = float(2 ** (bits - 1) - 1)
             scale = np.where(amax > 0, amax / qmax, 1.0).astype(np.float32)
-            q = np.clip(np.round(w32 / scale), -qmax, qmax).astype(np.int8)
+            q = np.clip(np.round(w32 * (np.float32(1.0) / scale)), -qmax, qmax).astype(np.int8)
         q = q.reshape(w.shape)
         scale = scale[:, 0]
         if bits == 4:

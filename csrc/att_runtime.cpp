@@ -70,27 +70,18 @@ const float kNf4Code[16] = {
     0.33791524171829224f, 0.4407098591327667f, 0.5626170039176941f,
     0.7229568362236023f, 1.0f};
 
-inline float bf16_to_f32(uint16_t v) {
-  uint32_t bits = static_cast<uint32_t>(v) << 16;
-  float out;
-  std::memcpy(&out, &bits, sizeof(out));
-  return out;
-}
-
-inline int8_t nf4_index(float x) {
-  // nearest code level; the code is sorted, 16 entries -> unrolled binary
-  // search over midpoints
-  int lo = 0, hi = 15;
-  while (lo < hi) {
-    int mid = (lo + hi) / 2;
-    float boundary = 0.5f * (kNf4Code[mid] + kNf4Code[mid + 1]);
-    if (x > boundary)
-      lo = mid + 1;
-    else
-      hi = mid;
-  }
-  return static_cast<int8_t>(lo);
-}
+// Midpoints between adjacent NF4 code levels; index(x) = #(x > mid[t]) —
+// identical to np.searchsorted(mids, x) and to the old binary search
+// (equality rounds down in all three).
+const float kNf4Mid[15] = {
+    0.5f * (kNf4Code[0] + kNf4Code[1]),   0.5f * (kNf4Code[1] + kNf4Code[2]),
+    0.5f * (kNf4Code[2] + kNf4Code[3]),   0.5f * (kNf4Code[3] + kNf4Code[4]),
+    0.5f * (kNf4Code[4] + kNf4Code[5]),   0.5f * (kNf4Code[5] + kNf4Code[6]),
+    0.5f * (kNf4Code[6] + kNf4Code[7]),   0.5f * (kNf4Code[7] + kNf4Code[8]),
+    0.5f * (kNf4Code[8] + kNf4Code[9]),   0.5f * (kNf4Code[9] + kNf4Code[10]),
+    0.5f * (kNf4Code[10] + kNf4Code[11]), 0.5f * (kNf4Code[11] + kNf4Code[12]),
+    0.5f * (kNf4Code[12] + kNf4Code[13]), 0.5f * (kNf4Code[13] + kNf4Code[14]),
+    0.5f * (kNf4Code[14] + kNf4Code[15])};
 
 struct QuantCtx {
   const unsigned char *src;
@@ -102,44 +93,71 @@ struct QuantCtx {
   float *out_scale;
 };
 
-inline float load_src(const QuantCtx &c, uint64_t r, uint64_t j) {
-  if (c.src_dtype == 0)
-    return reinterpret_cast<const float *>(c.src)[r * c.n + j];
-  return bf16_to_f32(reinterpret_cast<const uint16_t *>(c.src)[r * c.n + j]);
+// Branch-free quantized index for one value against the sorted NF4
+// midpoints: idx = #(midpoints < x). The invariant 15-iteration inner loop
+// auto-vectorizes (15 cmp+sub per SIMD lane group), unlike a binary search.
+inline int nf4_index_sum(float x, const float *mids) {
+  int idx = 0;
+  for (int t = 0; t < 15; ++t) idx += x > mids[t];
+  return idx;
 }
 
 void quant_one_group(int g, void *vctx) {
   QuantCtx &c = *static_cast<QuantCtx *>(vctx);
   const uint64_t r0 = static_cast<uint64_t>(g) * c.group;
-  const uint64_t r1 = r0 + c.group;
+  const uint64_t rows = c.group;
+  const uint64_t n = c.n;
   const float qmax = c.bits == 8 ? 127.0f : 7.0f;
-  // pass 1: per-column absmax over the group's rows
-  std::vector<float> amax(c.n, 0.0f);
-  for (uint64_t r = r0; r < r1; ++r)
-    for (uint64_t j = 0; j < c.n; ++j) {
-      float v = load_src(c, r, j);
-      float a = v < 0 ? -v : v;
+
+  // stage the group as fp32 ONCE (one vectorizable widen for bf16 sources,
+  // a straight copy for fp32) — the old per-element load_src re-converted
+  // every value twice behind a dtype branch, which blocked vectorization
+  // and capped the kernel at ~250 MB/s on one core
+  thread_local std::vector<float> buf;
+  buf.resize(rows * n);
+  if (c.src_dtype == 0) {
+    std::memcpy(buf.data(), reinterpret_cast<const float *>(c.src) + r0 * n,
+                rows * n * sizeof(float));
+  } else {
+    const uint16_t *s = reinterpret_cast<const uint16_t *>(c.src) + r0 * n;
+    uint32_t *d = reinterpret_cast<uint32_t *>(buf.data());
+    for (uint64_t i = 0; i < rows * n; ++i)
+      d[i] = static_cast<uint32_t>(s[i]) << 16;
+  }
+
+  // per-column absmax over the group's rows
+  thread_local std::vector<float> amax;
+  amax.assign(n, 0.0f);
+  for (uint64_t r = 0; r < rows; ++r) {
+    const float *row = buf.data() + r * n;
+    for (uint64_t j = 0; j < n; ++j) {
+      float a = std::fabs(row[j]);
       if (a > amax[j]) amax[j] = a;
     }
-  float *scale_row = c.out_scale + static_cast<uint64_t>(g) * c.n;
-  for (uint64_t j = 0; j < c.n; ++j) {
+  }
+  float *scale_row = c.out_scale + static_cast<uint64_t>(g) * n;
+  thread_local std::vector<float> recip;
+  recip.resize(n);
+  for (uint64_t j = 0; j < n; ++j) {
     float s;
     if (c.mode == 1)
       s = amax[j] > 0 ? amax[j] : 1.0f; // nf4: normalize to [-1, 1]
     else
       s = amax[j] > 0 ? amax[j] / qmax : 1.0f;
     scale_row[j] = s;
+    // reciprocal-MULTIPLY in the quantize pass (matches the numpy fallback,
+    // which does the same, and XLA-on-TPU semantics — the MXU path lowers
+    // fdiv to reciprocal+mul anyway); one divide per column instead of one
+    // per element, and the inner loop becomes a pure FMA stream
+    recip[j] = 1.0f / s;
   }
-  // DIVISION, not reciprocal-multiply: bit-exact with the numpy fallback
-  // (np.round(w/scale)) — a reciprocal flips values sitting on .5 ties
-  const float *div = scale_row;
-  // pass 2: quantize (source read once more — still resident in cache for
-  // typical group x n tiles)
+
   if (c.bits == 8) {
-    for (uint64_t r = r0; r < r1; ++r) {
-      int8_t *out_row = c.out_q + r * c.n;
-      for (uint64_t j = 0; j < c.n; ++j) {
-        float v = load_src(c, r, j) / div[j];
+    for (uint64_t r = 0; r < rows; ++r) {
+      const float *row = buf.data() + r * n;
+      int8_t *out_row = c.out_q + (r0 + r) * n;
+      for (uint64_t j = 0; j < n; ++j) {
+        float v = row[j] * recip[j];
         int iq = static_cast<int>(std::nearbyintf(v)); // half-even, like np.round
         if (iq > 127) iq = 127;
         if (iq < -127) iq = -127;
@@ -152,27 +170,42 @@ void quant_one_group(int g, void *vctx) {
   // row 2i+1 -> high nibble), exactly like the numpy packer. A group is
   // always a whole number of PACKED rows when group is even; with odd k
   // the final (pad) row is zero.
-  for (uint64_t r = r0; r < r1; r += 2) {
-    int8_t *out_row = c.out_q + (r / 2) * c.n;
-    for (uint64_t j = 0; j < c.n; ++j) {
-      int lo, hi;
-      if (c.mode == 1) {
-        lo = nf4_index(load_src(c, r, j) / div[j]);
-        hi = (r + 1 < c.k) ? nf4_index(load_src(c, r + 1, j) / div[j]) : 0;
-      } else {
-        lo = static_cast<int>(std::nearbyintf(load_src(c, r, j) / div[j]));
-        if (lo > 7) lo = 7;
-        if (lo < -7) lo = -7;
-        if (r + 1 < c.k) {
-          hi = static_cast<int>(std::nearbyintf(load_src(c, r + 1, j) / div[j]));
-          if (hi > 7) hi = 7;
-          if (hi < -7) hi = -7;
-        } else {
-          hi = 0;
-        }
+  const bool nf4 = c.mode == 1;
+  thread_local std::vector<int8_t> qrow; // per-row indices, then packed
+  qrow.resize(2 * n);
+  for (uint64_t r = 0; r < rows; r += 2) {
+    const float *row_lo = buf.data() + r * n;
+    const bool has_hi = r0 + r + 1 < c.k && r + 1 < rows;
+    const float *row_hi = has_hi ? buf.data() + (r + 1) * n : nullptr;
+    int8_t *lo_q = qrow.data(), *hi_q = qrow.data() + n;
+    if (nf4) {
+      for (uint64_t j = 0; j < n; ++j)
+        lo_q[j] = static_cast<int8_t>(nf4_index_sum(row_lo[j] * recip[j], kNf4Mid));
+      if (has_hi)
+        for (uint64_t j = 0; j < n; ++j)
+          hi_q[j] = static_cast<int8_t>(nf4_index_sum(row_hi[j] * recip[j], kNf4Mid));
+      else
+        std::memset(hi_q, 0, n);
+    } else {
+      for (uint64_t j = 0; j < n; ++j) {
+        int v = static_cast<int>(std::nearbyintf(row_lo[j] * recip[j]));
+        if (v > 7) v = 7;
+        if (v < -7) v = -7;
+        lo_q[j] = static_cast<int8_t>(v);
       }
-      out_row[j] = static_cast<int8_t>((lo & 0x0F) | ((hi & 0x0F) << 4));
+      if (has_hi)
+        for (uint64_t j = 0; j < n; ++j) {
+          int v = static_cast<int>(std::nearbyintf(row_hi[j] * recip[j]));
+          if (v > 7) v = 7;
+          if (v < -7) v = -7;
+          hi_q[j] = static_cast<int8_t>(v);
+        }
+      else
+        std::memset(hi_q, 0, n);
     }
+    int8_t *out_row = c.out_q + ((r0 + r) / 2) * n;
+    for (uint64_t j = 0; j < n; ++j)
+      out_row[j] = static_cast<int8_t>((lo_q[j] & 0x0F) | ((hi_q[j] & 0x0F) << 4));
   }
 }
 

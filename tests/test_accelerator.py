@@ -224,6 +224,52 @@ class TestFusedStep:
                 losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0] * 0.5
 
+    def test_steps_per_call_matches_sequential(self):
+        """steps_per_call=K over a stacked [K,...] batch must land on the
+        same params as K sequential step() calls (deterministic model, so
+        the differing RNG draw order is irrelevant)."""
+        from accelerate_tpu.state import AcceleratorState
+
+        def run(fused_k):
+            AcceleratorState._reset_state(reset_partial_state=True)
+            accelerator = make_accelerator()
+            model = make_regression_model()
+            optimizer = optax.sgd(0.05)
+            model, optimizer = accelerator.prepare(model, optimizer)
+            ds = RegressionDataset(length=48)
+            xs = np.asarray(ds.x[:48], np.float32).reshape(3, 16)
+            ys = np.asarray(ds.y[:48], np.float32).reshape(3, 16)
+            if fused_k:
+                step = accelerator.build_train_step(steps_per_call=3)
+                metrics = step({"x": xs, "y": ys})
+                assert "loss_mean" in metrics
+                assert np.isfinite(float(metrics["loss_mean"]))
+            else:
+                step = accelerator.build_train_step()
+                for i in range(3):
+                    step({"x": xs[i], "y": ys[i]})
+            return {k: np.asarray(v) for k, v in model.params.items()}
+
+        p_seq = run(False)
+        p_multi = run(True)
+        for k in p_seq:
+            np.testing.assert_allclose(p_seq[k], p_multi[k], rtol=1e-5, atol=1e-6)
+
+    def test_steps_per_call_rejected_with_compression(self):
+        from accelerate_tpu.state import AcceleratorState
+        from accelerate_tpu.utils.dataclasses import ShardingConfig
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        accelerator = make_accelerator(
+            sharding_config=ShardingConfig(replica=2, data_parallel=4,
+                                           grad_compression_dtype="bfloat16")
+        )
+        model = make_regression_model()
+        optimizer = optax.sgd(0.05)
+        model, optimizer = accelerator.prepare(model, optimizer)
+        with pytest.raises(NotImplementedError, match="steps_per_call"):
+            accelerator.build_train_step(steps_per_call=2)
+
     def test_fused_matches_eager(self):
         def run(fused):
             from accelerate_tpu.state import AcceleratorState
