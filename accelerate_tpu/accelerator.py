@@ -789,7 +789,10 @@ class TrainEngine:
                 )
                 metrics = jax.tree_util.tree_map(lambda x: x[-1], ms)
                 metrics["loss_mean"] = jnp.mean(ms["loss"])
-                return p, o, es, ss, sk[-1], metrics
+                # ANY skipped step inside the fused window must surface
+                # through optimizer_step_was_skipped, not just the last one
+                skipped_any = jnp.any(jnp.asarray(sk)) if sk is not None else sk
+                return p, o, es, ss, skipped_any, metrics
 
             fused_fn = multi_fn
         else:

@@ -239,8 +239,13 @@ class DispatchedModel:
         base = ensure_persistent_compile_cache()
         if base is None:
             return None
+        from . import __version__ as att_version
+
         mat = repr((
             jax.__version__,
+            # package version: param_placer/dequantize logic is baked into
+            # the traced program, so an upgrade must invalidate artifacts
+            att_version,
             repr(self.definition),
             key,
             aval_key,

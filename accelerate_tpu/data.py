@@ -559,6 +559,7 @@ class DataLoaderDispatcher(BaseDataLoader):
         # main process reads the batch; all processes learn the structure
         # (+ the real row count of a padded ragged tail), then the global
         # array is built from main's data only.
+        main_err = None
         if state.is_main_process:
             try:
                 batch = convert_to_jax(next(iterator))
@@ -566,10 +567,22 @@ class DataLoaderDispatcher(BaseDataLoader):
                 info = [_tree_meta(batch), real_rows]
             except StopIteration:
                 info = [None, None]
+            except Exception as e:
+                # ANY main-only raise (ragged-tail rejection, a dataset
+                # __getitem__ bug, IO errors...) would leave every other rank
+                # parked in the broadcast below — a silent desync. Ship the
+                # error so ALL ranks raise together; main re-raises the
+                # original with its traceback.
+                main_err = e
+                info = [("__dispatch_error__", f"{type(e).__name__}: {e}"), None]
         else:
             batch, info = None, [None, None]
         if state.num_processes > 1:
             info = broadcast_object_list(info)
+        if isinstance(info[0], tuple) and len(info[0]) == 2 and info[0][0] == "__dispatch_error__":
+            if main_err is not None:
+                raise main_err
+            raise RuntimeError(f"dispatch main process failed: {info[0][1]}")
         if info[0] is None:
             return None
         if info[1] is not None:

@@ -215,6 +215,104 @@ def test_dispatch_split_batches(accelerator, batch_size):
     accelerator.print("dispatch x split_batches ragged coverage OK")
 
 
+def test_split_batches_ragged(accelerator, batch_size):
+    """split_batches x uneven tail (reference matrix row): the ragged final
+    global batch wraps around, gather_for_metrics drops the duplicates."""
+    from accelerate_tpu.data import DataLoader
+    from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+    world = accelerator.num_processes
+    global_bs = batch_size * world
+    n = global_bs * 2 + world + 1
+    accelerator.dataloader_config = DataLoaderConfiguration(split_batches=True)
+    dl = accelerator.prepare(DataLoader(ArangeDataset(n), batch_size=global_bs))
+    accelerator.dataloader_config = DataLoaderConfiguration()
+    kept = []
+    for batch in dl:
+        assert len(_ids(batch)) == global_bs  # static shape incl. wraparound
+        out = accelerator.gather_for_metrics(batch["x"])
+        kept += np.asarray(out)[:, 0].astype(int).tolist()
+    assert sorted(kept) == list(range(n)), (sorted(kept)[:10], n)
+    accelerator.print("split_batches ragged coverage OK")
+
+
+def test_dispatch_even_batches_off(accelerator, batch_size):
+    """dispatch x even_batches=False (reference uneven-dispatch row). Static
+    XLA shapes cannot carry a ragged final batch, so the TPU-native contract
+    is: exact-multiple streams work without wraparound, and a ragged tail
+    raises the documented error telling the user to drop_last or pad."""
+    from accelerate_tpu.data import DataLoader, DataLoaderDispatcher
+    from accelerate_tpu.utils.operations import gather_object
+
+    world = accelerator.num_processes
+    global_bs = batch_size * world
+    # exact multiple: even_batches=False must cover exactly, no padding
+    n = global_bs * 3
+    base = DataLoader(ArangeDataset(n), batch_size=global_bs)
+    dl = DataLoaderDispatcher(
+        base, mesh=accelerator.mesh, batch_size=batch_size, even_batches=False
+    )
+    got = [_ids(b) for b in dl]
+    assert sorted(v for b in got for v in b) == list(range(n)), got
+    counts = gather_object([len(got)])
+    assert len(set(counts)) == 1, counts
+
+    # ragged tail: the documented rejection (static shapes cannot go ragged)
+    n2 = global_bs * 2 + world
+    base2 = DataLoader(ArangeDataset(n2), batch_size=global_bs)
+    dl2 = DataLoaderDispatcher(
+        base2, mesh=accelerator.mesh, batch_size=batch_size, even_batches=False
+    )
+    raised = False
+    try:
+        for _ in dl2:
+            pass
+    except ValueError as e:
+        raised = "even_batches=False" in str(e)
+    assert raised, "ragged dispatch with even_batches=False must raise the documented error"
+    accelerator.print("dispatch x even_batches=False exact cover + ragged rejection OK")
+
+
+def test_seedable_reshuffle_across_epochs(accelerator, batch_size):
+    """Seedable shuffling: every rank sees the same permutation within an
+    epoch (global batches partition the dataset), and the permutation
+    CHANGES between epochs (reference SeedableRandomSampler semantics)."""
+    from accelerate_tpu.data import DataLoader
+
+    world = accelerator.num_processes
+    n = batch_size * world * 3
+    dl = accelerator.prepare(
+        DataLoader(ArangeDataset(n), batch_size=batch_size, shuffle=True)
+    )
+    epochs = []
+    for epoch in range(2):
+        if hasattr(dl, "set_epoch"):
+            dl.set_epoch(epoch)
+        order = []
+        for batch in dl:
+            order += _ids(batch)
+        assert set(order) == set(range(n)), "shuffled epoch must cover the dataset"
+        epochs.append(order)
+    assert epochs[0] != epochs[1], "epochs produced identical shuffles"
+    accelerator.print("seedable reshuffle across epochs OK")
+
+
+def test_skip_first_batches_dispatch(accelerator, batch_size):
+    """skip_first_batches composes with the dispatch path (mid-epoch resume
+    on the rank0-driven stream)."""
+    from accelerate_tpu import skip_first_batches
+    from accelerate_tpu.data import DataLoader, DataLoaderDispatcher
+
+    world = accelerator.num_processes
+    global_bs = batch_size * world
+    base = DataLoader(ArangeDataset(global_bs * 4), batch_size=global_bs, drop_last=True)
+    dl = DataLoaderDispatcher(base, mesh=accelerator.mesh, batch_size=batch_size)
+    full = [_ids(b) for b in dl]
+    skipped = [_ids(b) for b in skip_first_batches(dl, 2)]
+    assert skipped == full[2:], (skipped, full)
+    accelerator.print("skip_first_batches x dispatch OK")
+
+
 def main():
     from accelerate_tpu import Accelerator
 
@@ -232,6 +330,11 @@ def main():
     test_even_batches_off(accelerator, bs)
     test_split_batches(accelerator, 8 * world * 2)
     test_skip_first_batches(accelerator, bs * world * 4, bs)
+    # reference-matrix rows added round 5: uneven x dispatch x split sweeps
+    test_split_batches_ragged(accelerator, bs)
+    test_dispatch_even_batches_off(accelerator, bs)
+    test_seedable_reshuffle_across_epochs(accelerator, bs)
+    test_skip_first_batches_dispatch(accelerator, bs)
     from accelerate_tpu.state import PartialState
 
     PartialState().wait_for_everyone()

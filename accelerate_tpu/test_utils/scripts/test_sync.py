@@ -126,7 +126,10 @@ def test_accumulation_matches_big_batch(accelerator_factory):
         accelerator = accelerator_factory(
             gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=accum)
         )
-        model, optimizer, dl = _setup(accelerator, length=32, batch_size=batch_size)
+        # world-sized: the micro run must see exactly one FULL accum window
+        # (a lone tail batch would be scaled /accum and diverge by design)
+        length = 16 * accelerator.num_processes
+        model, optimizer, dl = _setup(accelerator, length=length, batch_size=batch_size)
         for batch in dl:
             with accelerator.accumulate(model):
                 out = model(batch["x"], batch["y"])
@@ -184,12 +187,80 @@ def test_sync_each_batch_updates_params(accelerator_factory, accum_steps: int = 
     accelerator.print(f"sync_each_batch updates params every batch OK (accum={accum_steps})")
 
 
+def test_accumulation_per_step_param_parity(
+    accelerator_factory, accum_steps: int, sync_each_batch: bool
+):
+    """The reference sweep's strongest observable
+    (test_sync.py:207-404): after EVERY batch, the distributed params must
+    equal a from-scratch numpy replica of the specified semantics —
+    micro-loss divided by num_steps, grads all-reduduced as the global mean
+    over every rank's rows, SGD applied exactly at sync points (window end,
+    dataloader end, or every batch under sync_each_batch)."""
+    from accelerate_tpu import GradientAccumulationPlugin
+    from accelerate_tpu.test_utils import RegressionDataset
+
+    accelerator = accelerator_factory(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=accum_steps, sync_each_batch=sync_each_batch
+        )
+    )
+    n = accelerator.num_processes
+    # an exact multiple of the global batch: the replica models plain means,
+    # not the even_batches wraparound (covered by the data-loop matrix)
+    lr, bs = 0.05, 8
+    length = bs * n * 6
+    model, optimizer, dl = _setup(accelerator, length=length, batch_size=bs, lr=lr)
+    ds = RegressionDataset(length=length, seed=7)
+    xs, ys = np.asarray(ds.x), np.asarray(ds.y)
+    n_batches = len(dl)
+    global_rows = bs * n
+
+    a_ref = float(_params_np(model)["a"])
+    b_ref = float(_params_np(model)["b"])
+    acc_a = acc_b = 0.0
+    for i, batch in enumerate(dl):
+        with accelerator.accumulate(model):
+            out = model(batch["x"], batch["y"])
+            accelerator.backward(out["loss"])
+            synced = accelerator.sync_gradients
+            optimizer.step()
+            optimizer.zero_grad()
+        # numpy replica: this global batch is the union of every rank's rows
+        x = xs[i * global_rows:(i + 1) * global_rows]
+        y = ys[i * global_rows:(i + 1) * global_rows]
+        err = a_ref * x + b_ref - y
+        acc_a += float(np.mean(2 * err * x)) / accum_steps
+        acc_b += float(np.mean(2 * err)) / accum_steps
+        expect_sync = sync_each_batch or ((i + 1) % accum_steps == 0) or (i == n_batches - 1)
+        assert synced == expect_sync, (i, synced, expect_sync)
+        if expect_sync:
+            a_ref -= lr * acc_a
+            b_ref -= lr * acc_b
+            acc_a = acc_b = 0.0
+        got = _params_np(model)
+        np.testing.assert_allclose(
+            float(got["a"]), a_ref, rtol=1e-5, atol=1e-7, err_msg=f"batch {i}"
+        )
+        np.testing.assert_allclose(
+            float(got["b"]), b_ref, rtol=1e-5, atol=1e-7, err_msg=f"batch {i}"
+        )
+    _assert_params_synced(accelerator, model)
+    accelerator.print(
+        f"per-step param parity OK (accum={accum_steps}, sync_each_batch={sync_each_batch})"
+    )
+
+
 def main():
     factory = _fresh_accelerator
     for accum in (1, 2, 3):
         test_sync_flag_pattern(factory, accum)
     for accum in (1, 2, 4):  # the full sync_each_batch x accum matrix rows
         test_sync_each_batch(factory, accum)
+    # the reference's full accumulation x sync_each_batch sweep, asserted on
+    # params after every single batch against an independent numpy replica
+    for accum in (1, 2, 3):
+        for seb in (False, True):
+            test_accumulation_per_step_param_parity(factory, accum, seb)
     test_sync_each_batch_updates_params(factory)
     test_dataloader_end_forces_sync(factory)
     test_accumulation_matches_big_batch(factory)
