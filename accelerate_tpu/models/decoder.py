@@ -164,9 +164,17 @@ class DecoderAttention(nn.Module):
         wo = self.param("wo", nn.with_logical_partitioning(_dense_init(), ("heads", "head_dim", "embed")), (h, d, e))
 
         dt = cfg.dtype
-        q = jnp.einsum("bse,ehd->bhsd", x, wq.astype(dt))
-        k = jnp.einsum("bse,ehd->bhsd", x, wk.astype(dt))
-        v = jnp.einsum("bse,ehd->bhsd", x, wv.astype(dt))
+        if getattr(cfg, "use_fp8", False):
+            # TE parity: QKV through the fp8 recipe (ops/fp8.fp8_attn_proj)
+            from ..ops.fp8 import fp8_attn_proj
+
+            q = fp8_attn_proj(self, "wq_fp8", x, wq.astype(dt), h, d, cfg)
+            k = fp8_attn_proj(self, "wk_fp8", x, wk.astype(dt), kv, d, cfg)
+            v = fp8_attn_proj(self, "wv_fp8", x, wv.astype(dt), kv, d, cfg)
+        else:
+            q = jnp.einsum("bse,ehd->bhsd", x, wq.astype(dt))
+            k = jnp.einsum("bse,ehd->bhsd", x, wk.astype(dt))
+            v = jnp.einsum("bse,ehd->bhsd", x, wv.astype(dt))
         q = _constrain(q, ("batch", "heads", "seq", "head_dim"), self.mesh)
         k = _constrain(k, ("batch", "kv_heads", "seq", "head_dim"), self.mesh)
         q = apply_rotary_embedding(q, sin, cos)
@@ -212,7 +220,12 @@ class DecoderAttention(nn.Module):
                 q, k, v, causal=self.causal, kv_mask=kv_mask, impl=cfg.attention_impl
             )
         out = _constrain(out, ("batch", "heads", "seq", "head_dim"), self.mesh)
-        out = jnp.einsum("bhsd,hde->bse", out, wo.astype(dt))
+        if getattr(cfg, "use_fp8", False):
+            from ..ops.fp8 import fp8_attn_out
+
+            out = fp8_attn_out(self, "wo_fp8", out, wo.astype(dt), cfg)
+        else:
+            out = jnp.einsum("bhsd,hde->bse", out, wo.astype(dt))
         return _constrain(out, ("batch", "seq", "embed"), self.mesh)
 
 

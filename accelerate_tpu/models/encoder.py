@@ -51,12 +51,24 @@ class EncoderBlock(nn.Module):
         ln2_b = self.param("ln2_bias", nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)), (e,))
 
         dt = cfg.dtype
-        q = jnp.einsum("bse,ehd->bhsd", x, wq.astype(dt))
-        k = jnp.einsum("bse,ehd->bhsd", x, wk.astype(dt))
-        v = jnp.einsum("bse,ehd->bhsd", x, wv.astype(dt))
+        from ..ops.fp8 import fp8_attn_out, fp8_attn_proj, module_fp8_dot
+
+        if getattr(cfg, "use_fp8", False):
+            # TE parity: QKV/O projections through the fp8 recipe too
+            # (reference transformer_engine.py:38-52 swaps every Linear)
+            q = fp8_attn_proj(self, "wq_fp8", x, wq.astype(dt), h, d, cfg)
+            k = fp8_attn_proj(self, "wk_fp8", x, wk.astype(dt), h, d, cfg)
+            v = fp8_attn_proj(self, "wv_fp8", x, wv.astype(dt), h, d, cfg)
+        else:
+            q = jnp.einsum("bse,ehd->bhsd", x, wq.astype(dt))
+            k = jnp.einsum("bse,ehd->bhsd", x, wk.astype(dt))
+            v = jnp.einsum("bse,ehd->bhsd", x, wv.astype(dt))
         # padding as kv_mask keeps padded batches on the flash-kernel path
         attn = dot_product_attention(q, k, v, causal=False, kv_mask=kv_mask)
-        attn = jnp.einsum("bhsd,hde->bse", attn, wo.astype(dt))
+        if getattr(cfg, "use_fp8", False):
+            attn = fp8_attn_out(self, "wo_fp8", attn, wo.astype(dt), cfg)
+        else:
+            attn = jnp.einsum("bhsd,hde->bse", attn, wo.astype(dt))
         if cfg.dropout_rate > 0.0:
             attn = nn.Dropout(cfg.dropout_rate)(attn, deterministic=deterministic)
         x = _layer_norm(x + attn, ln1_s, ln1_b, cfg.norm_eps)
@@ -66,8 +78,6 @@ class EncoderBlock(nn.Module):
         bi = self.param("b_in", nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)), (cfg.mlp_dim,))
         wo2 = self.param("w_out", nn.with_logical_partitioning(_dense_init(), ("mlp", "embed")), (cfg.mlp_dim, e))
         bo2 = self.param("b_out", nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)), (e,))
-        from ..ops.fp8 import module_fp8_dot
-
         hidden = jax.nn.gelu(module_fp8_dot(self, "mlp_in", x, wi.astype(dt), cfg) + bi.astype(dt))
         hidden = _constrain(hidden, ("batch", "seq", "mlp"), self.mesh)
         out = module_fp8_dot(self, "mlp_out", hidden, wo2.astype(dt), cfg) + bo2.astype(dt)

@@ -150,7 +150,14 @@ class _CrossAttention(nn.Module):
         wo = self.param("wo", nn.with_logical_partitioning(_dense_init(), ("heads", "head_dim", "embed")), (h, d, e))
 
         dt = cfg.dtype
-        q = jnp.einsum("bse,ehd->bhsd", x, wq.astype(dt))
+        use_fp8 = getattr(cfg, "use_fp8", False)
+        from ..ops.fp8 import fp8_attn_out, fp8_attn_proj
+
+        if use_fp8:
+            # TE parity: cross-attention QKV/O through the shared fp8 helpers
+            q = fp8_attn_proj(self, "wq_fp8", x, wq.astype(dt), h, d, cfg)
+        else:
+            q = jnp.einsum("bse,ehd->bhsd", x, wq.astype(dt))
         q = _constrain(q, ("batch", "heads", "seq", "head_dim"), self.mesh)
 
         if self.use_cache:
@@ -161,8 +168,12 @@ class _CrossAttention(nn.Module):
             if not self.decode:
                 if enc is None:
                     raise ValueError("cross-attention prefill needs the encoder output")
-                k = jnp.einsum("bte,ehd->bhtd", enc, wk.astype(dt))
-                v = jnp.einsum("bte,ehd->bhtd", enc, wv.astype(dt))
+                if use_fp8:
+                    k = fp8_attn_proj(self, "wk_fp8", enc, wk.astype(dt), kv, d, cfg)
+                    v = fp8_attn_proj(self, "wv_fp8", enc, wv.astype(dt), kv, d, cfg)
+                else:
+                    k = jnp.einsum("bte,ehd->bhtd", enc, wk.astype(dt))
+                    v = jnp.einsum("bte,ehd->bhtd", enc, wv.astype(dt))
                 t = enc.shape[1]
                 mask = enc_mask if enc_mask is not None else jnp.ones((b, t), jnp.int32)
                 # right-pad to the static cache width; padding is masked out
@@ -175,14 +186,21 @@ class _CrossAttention(nn.Module):
         else:
             if enc is None:
                 raise ValueError("cross-attention needs the encoder output")
-            k = jnp.einsum("bte,ehd->bhtd", enc, wk.astype(dt))
-            v = jnp.einsum("bte,ehd->bhtd", enc, wv.astype(dt))
+            if use_fp8:
+                k = fp8_attn_proj(self, "wk_fp8", enc, wk.astype(dt), kv, d, cfg)
+                v = fp8_attn_proj(self, "wv_fp8", enc, wv.astype(dt), kv, d, cfg)
+            else:
+                k = jnp.einsum("bte,ehd->bhtd", enc, wk.astype(dt))
+                v = jnp.einsum("bte,ehd->bhtd", enc, wv.astype(dt))
             mask = enc_mask
         k = _constrain(k, ("batch", "kv_heads", None, "head_dim"), self.mesh)
 
         out = dot_product_attention(q, k, v, causal=False, kv_mask=mask, impl=cfg.attention_impl)
         out = _constrain(out, ("batch", "heads", "seq", "head_dim"), self.mesh)
-        out = jnp.einsum("bhsd,hde->bse", out, wo.astype(dt))
+        if use_fp8:
+            out = fp8_attn_out(self, "wo_fp8", out, wo.astype(dt), cfg)
+        else:
+            out = jnp.einsum("bhsd,hde->bse", out, wo.astype(dt))
         return _constrain(out, ("batch", "seq", "embed"), self.mesh)
 
 
