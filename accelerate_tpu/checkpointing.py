@@ -155,13 +155,24 @@ def load_accelerator_state(
         model_path = _find(input_dir, f"{MODEL_NAME}_{i}")
         if model_path:
             flat = load_flat_dict(model_path)
-            like = {"params": engine.params}
+            sep = "params/"
+            params_flat = {k[len(sep):]: v for k, v in flat.items() if k.startswith(sep)}
+            if not params_flat:  # pre-extra_state checkpoints: flat IS params
+                params_flat = {k: v for k, v in flat.items() if not k.startswith("extra_state/")}
+            sd = {
+                "params": unflatten_to_like(params_flat, engine.params),
+                "step_count": 0,
+            }
             if engine.extra_state:
-                like["extra_state"] = engine.extra_state
-            tree = unflatten_to_like(flat, like)
-            sd = {"params": tree["params"], "step_count": 0}
-            if "extra_state" in tree:
-                sd["extra_state"] = tree["extra_state"]
+                es_flat = {
+                    k[len("extra_state/"):]: v
+                    for k, v in flat.items() if k.startswith("extra_state/")
+                }
+                # lenient: aux-state keys an older checkpoint lacks (e.g.
+                # amax histories added by an upgrade) seed fresh
+                sd["extra_state"] = unflatten_to_like(
+                    es_flat, engine.extra_state, missing="keep"
+                )
             opt_path = _find(input_dir, f"{OPTIMIZER_NAME}_{i}")
             if opt_path and engine.opt_state is not None:
                 opt_flat = load_flat_dict(opt_path)

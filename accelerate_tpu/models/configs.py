@@ -62,7 +62,7 @@ class DecoderConfig:
     pipeline_schedule: str = "gpipe"
     # KV-cache length for generation (None -> max_seq_len)
     max_cache_len: Optional[int] = None
-    # fp8 recipe (ops/fp8.py): MLP contractions run e4m3-fwd/e5m2-bwd.
+    # fp8 recipe (ops/fp8.py): every Linear-equivalent contraction (QKV/O + MLP) runs e4m3-fwd/e5m2-bwd.
     # Flipped on by Accelerator(mixed_precision="fp8"). ``fp8_recipe``:
     # "current" (per-tensor amax each step, XLA fuses the reduction) or
     # "delayed" (TE DelayedScaling parity: scales from a rolling amax
@@ -203,7 +203,7 @@ class EncoderConfig:
     norm_eps: float = 1e-12
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
-    # fp8 MLP contractions (ops/fp8.py), same knobs as DecoderConfig
+    # fp8 on QKV/O + MLP contractions (ops/fp8.py), same knobs as DecoderConfig
     use_fp8: bool = False
     fp8_recipe: str = "current"
     fp8_amax_history_len: int = 16

@@ -74,7 +74,7 @@ class Seq2SeqConfig:
     scan_layers: bool = True
     fused_ce_chunks: int = 8
     max_cache_len: Optional[int] = None  # decode cache (None -> max_target_len)
-    # fp8 recipe on the MLP contractions (shared DecoderMLP, ops/fp8.py)
+    # fp8 recipe on QKV/O + MLP contractions (shared decoder blocks, ops/fp8.py)
     use_fp8: bool = False
     fp8_recipe: str = "current"
     fp8_amax_history_len: int = 16

@@ -177,9 +177,14 @@ _delayed_fallback_warned = False
 
 
 def _warn_delayed_fallback_once():
-    """The user asked for the delayed recipe but is silently getting current
-    scaling — different numerics than requested deserve one loud warning
-    (round-4 review: the quiet fallback hid the recipe swap entirely)."""
+    """The delayed recipe was requested but this apply is running current
+    scaling — different numerics than the config states deserve one loud
+    notice (round-4 review: the quiet fallback hid the recipe swap). Two
+    ways to get here, both covered by the message: the model was init'd
+    without the recipe (the stats collection never existed), or this
+    PARTICULAR apply didn't receive the collection — e.g. inference/
+    generation passing only {'params': ...}, where history-free current
+    scaling is the normal and correct behavior."""
     global _delayed_fallback_warned
     if _delayed_fallback_warned:
         return
@@ -187,11 +192,12 @@ def _warn_delayed_fallback_once():
     import warnings
 
     warnings.warn(
-        "fp8_recipe='delayed' was requested but the model's 'fp8_stats' "
-        "collection was never initialized, so CURRENT scaling is used "
-        "instead. To get the delayed amax-history recipe, set use_fp8=True "
-        "and fp8_recipe='delayed' in the model config BEFORE init so the "
-        "history variables exist.",
+        "fp8_recipe='delayed' is configured but this apply has no "
+        "'fp8_stats' collection, so CURRENT scaling is used for it. If "
+        "this is inference/generation (apply with only {'params': ...}), "
+        "that is expected — the amax history is a training-time state. If "
+        "this is training, init the model with use_fp8=True and "
+        "fp8_recipe='delayed' so the history variables exist.",
         stacklevel=3,
     )
 

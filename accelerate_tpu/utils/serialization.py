@@ -45,15 +45,37 @@ def _path_str(path) -> str:
     return FLAT_SEP.join(parts)
 
 
-def unflatten_to_like(flat: Mapping[str, Any], like):
-    """Rebuild a pytree with the structure of ``like`` from a flat dict."""
+def unflatten_to_like(flat: Mapping[str, Any], like, missing: str = "error"):
+    """Rebuild a pytree with the structure of ``like`` from a flat dict.
+
+    ``missing="keep"`` keeps ``like``'s own leaf for keys absent from
+    ``flat`` (with one warning listing how many) — checkpoint
+    FORWARD-compat for auxiliary state: e.g. a delayed-fp8 checkpoint saved
+    before the recipe covered QKV/O lacks those amax histories, and resume
+    should seed them fresh rather than hard-fail. Params restores stay
+    ``missing="error"``: a missing weight is a real error."""
     like_flat, treedef = jax.tree_util.tree_flatten_with_path(like)
     leaves = []
+    kept = []
     for path, leaf in like_flat:
         key = _path_str(path)
         if key not in flat:
+            if missing == "keep":
+                kept.append(key)
+                leaves.append(leaf)
+                continue
             raise KeyError(f"missing key {key!r} in checkpoint (have {len(flat)} keys)")
         leaves.append(flat[key])
+    if kept:
+        import warnings
+
+        warnings.warn(
+            f"{len(kept)} state entries absent from the checkpoint kept "
+            f"their current (fresh) values, e.g. {kept[0]!r} — expected "
+            "when resuming an older checkpoint after an upgrade added "
+            "auxiliary state.",
+            stacklevel=2,
+        )
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Multi-node submit recipe (reference examples/slurm/submit_multinode.sh
+# analog). One task per node; accelerate-tpu launch inside each task reads
+# the rendezvous info from the environment this script derives from slurm.
+#SBATCH --job-name=accelerate-tpu-train
+#SBATCH --nodes=2
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task=32
+#SBATCH --time=08:00:00
+#SBATCH --output=%x_%j.out
+
+set -euo pipefail
+
+export MAIN_IP=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1)
+export MAIN_PORT=29500
+
+srun bash -c '
+  accelerate-tpu launch \
+    --num_processes "$SLURM_NNODES" \
+    --main_process_ip "$MAIN_IP" \
+    --main_process_port "$MAIN_PORT" \
+    --mixed_precision bf16 \
+    --fsdp -1 \
+    train.py --epochs 3
+'

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Cloud TPU pod submit recipe: the launcher does the per-worker ssh fan-out
+# itself (reference tpu_pod_launcher analog), so this is a plain shell
+# script you run from anywhere with gcloud credentials.
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:-my-v5e-pod}
+TPU_ZONE=${TPU_ZONE:-us-west4-a}
+TPU_PROJECT=${TPU_PROJECT:-my-project}
+
+exec accelerate-tpu launch \
+  --tpu_name "$TPU_NAME" \
+  --tpu_zone "$TPU_ZONE" \
+  --tpu_project "$TPU_PROJECT" \
+  --mixed_precision bf16 \
+  --fsdp -1 \
+  train.py "$@"

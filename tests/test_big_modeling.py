@@ -236,6 +236,7 @@ class TestDispatch:
         out = dispatched(ids)["logits"]
         np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_disk_offload_matches_dense(self, tmp_path):
         model, cfg = _tiny_model()
         params, ids, ref = self._params_and_batch(model, cfg)
@@ -244,6 +245,7 @@ class TestDispatch:
         np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
         assert os.path.exists(tmp_path / "index.json")
 
+    @pytest.mark.slow
     def test_mixed_dispatch_matches_dense(self, tmp_path):
         model, cfg = _tiny_model()
         params, ids, ref = self._params_and_batch(model, cfg)

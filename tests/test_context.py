@@ -42,6 +42,7 @@ class TestRingAttention:
         ref = mha_reference(q, k, v, causal=causal)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_matches_reference_mixed_mesh(self):
         mesh = _mesh(data=2, sequence=2, tensor=2)
         q, k, v = _qkv(jax.random.PRNGKey(1))
@@ -49,6 +50,7 @@ class TestRingAttention:
         ref = mha_reference(q, k, v, causal=True)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_gqa(self):
         mesh = _mesh(sequence=4, data=2)
         q, k, v = _qkv(jax.random.PRNGKey(2), h=4, kvh=2)
@@ -61,7 +63,8 @@ class TestRingAttention:
         # 1-core sim) while still exercising a real rotation + lse merge;
         # the seq=4 depth is covered by the slow-marked flash variants
         mesh = _mesh(sequence=2, data=4)
-        q, k, v = _qkv(jax.random.PRNGKey(3))
+        # s=32: half the unrolled ring-VJP graph of s=64, same invariant
+        q, k, v = _qkv(jax.random.PRNGKey(3), s=32)
 
         def loss_ring(q, k, v):
             return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=True) ** 2)
@@ -102,6 +105,7 @@ class TestRingFlashInner:
         ref = mha_reference(q, k, v, causal=True)
         np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_flash_inner_grads_match_reference(self):
         mesh = _mesh(sequence=2, data=4)
         q, k, v = _qkv(jax.random.PRNGKey(7), b=1, h=2, s=256, d=128)

@@ -179,6 +179,22 @@ class TestExamplesRun:
         assert r.returncode == 0, r.stderr
         assert "pipelined forward OK" in r.stdout
 
+    @pytest.mark.parametrize(
+        "script,marker",
+        [
+            ("bert.py", "encoder dispatch OK"),
+            ("gpt2.py", "generation OK"),
+            ("t5.py", "seq2seq dispatch + generation OK"),
+            ("moe.py", "moe generation OK"),
+        ],
+    )
+    def test_inference_architecture_matrix(self, script, marker):
+        """Per-architecture dispatch/serving scripts (reference
+        examples/inference/pippy/{bert,gpt2,t5}.py analog + MoE)."""
+        r = _run_inference_example(os.path.join("inference", script))
+        assert r.returncode == 0, r.stderr
+        assert marker in r.stdout
+
     def test_complete_example_checkpoints_and_resumes(self, tmp_path):
         r = _run_example(
             "complete_nlp_example.py",

@@ -112,6 +112,7 @@ class TestPipelineGeneration:
     stage-stacked layers back into the layer scan (decode is serial across
     stages by construction, so the GPipe schedule buys nothing)."""
 
+    @pytest.mark.slow
     def test_pipeline_generate_matches_dense(self):
         from accelerate_tpu.generation import depipeline
         from accelerate_tpu.parallel.pipeline import remap_params_to_pipeline

@@ -82,7 +82,7 @@ class TestDecoderTraining:
         step = accelerator.build_train_step()
         ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 32))
         batch = accelerator.prepare_for_eval({"input_ids": ids, "labels": ids})
-        losses = [float(step(batch)["loss"]) for _ in range(8)]
+        losses = [float(step(batch)["loss"]) for _ in range(6)]
         assert losses[-1] < losses[0], losses
 
     def test_param_sharding_actually_shards(self):
@@ -133,5 +133,5 @@ class TestEncoderClassifier:
         batch = accelerator.prepare_for_eval(
             {"input_ids": ids, "labels": labels}
         )
-        losses = [float(step(batch)["loss"]) for _ in range(8)]
+        losses = [float(step(batch)["loss"]) for _ in range(6)]
         assert losses[-1] < losses[0], losses

@@ -210,6 +210,7 @@ class TestAutoWiring:
 
 
 class TestMicrobatchAdaptation:
+    @pytest.mark.slow
     def test_odd_batch_adapts_schedule(self):
         """init_variables (batch 1) and ragged eval batches trace fine: M
         adapts down to divide the batch."""

@@ -476,7 +476,7 @@ class TestFp8DelayedScaling:
         model, opt = acc.prepare(Model(model_def, variables), optax.adam(1e-2))
         ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16)))
         losses = []
-        for _ in range(5):
+        for _ in range(3):
             out = model(ids, labels=ids)
             acc.backward(out["loss"])
             opt.step()
@@ -507,7 +507,7 @@ class TestFp8DelayedScaling:
         ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)))
         labels = jnp.asarray(rng.randint(0, cfg.num_labels, (8,)))
         losses = []
-        for _ in range(8):
+        for _ in range(4):
             out = model(ids, labels=labels)
             acc.backward(out["loss"])
             opt.step()
@@ -563,6 +563,62 @@ class TestFp8DelayedScaling:
                 DecoderConfig.tiny(num_layers=2), use_fp8=True,
                 fp8_recipe="delayed", pipeline_stages=2,
             )
+
+    def test_old_checkpoint_without_new_histories_still_loads(self, tmp_path):
+        """Checkpoint forward-compat: a delayed-fp8 save from before the
+        QKV/O scope extension lacks those amax histories — resume must seed
+        them fresh (with a warning), not KeyError (round-5 review)."""
+        import dataclasses
+        import warnings
+
+        import optax
+
+        from accelerate_tpu import Accelerator, Model
+        from accelerate_tpu.models import DecoderConfig, DecoderLM
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc = Accelerator(mixed_precision="fp8")
+        cfg = dataclasses.replace(
+            DecoderConfig.tiny(), use_fp8=True, fp8_recipe="delayed",
+            fp8_amax_history_len=4,
+        )
+        model_def = DecoderLM(cfg, mesh=acc.mesh)
+        variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=8, seq_len=16)
+        model, opt = acc.prepare(Model(model_def, variables), optax.adam(1e-2))
+        step = acc.build_train_step()
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16))
+        batch = acc.prepare_for_eval({"input_ids": ids, "labels": ids})
+        step(batch)
+        acc.save_state(str(tmp_path / "ck"))
+
+        # simulate the OLD checkpoint: the loader sees a flat dict WITHOUT
+        # the attention histories (monkeypatched so the test covers the
+        # lenient restore branch independent of shard layout)
+        import accelerate_tpu.checkpointing as ckpt_mod
+
+        real_load = ckpt_mod.load_flat_dict
+
+        def load_without_new_keys(path, *a, **k):
+            flat = real_load(path, *a, **k)
+            return {k2: v for k2, v in flat.items() if "_fp8" not in k2}
+
+        params_before = float(np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(model.params)[0])).sum())
+        ckpt_mod.load_flat_dict = load_without_new_keys
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                acc.load_state(str(tmp_path / "ck"))
+        finally:
+            ckpt_mod.load_flat_dict = real_load
+        assert any("absent from the checkpoint" in str(x.message) for x in w)
+        params_after = float(np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(model.params)[0])).sum())
+        np.testing.assert_allclose(params_after, params_before, rtol=1e-6)
+        # training continues
+        m = step(batch)
+        assert np.isfinite(float(jax.device_get(m["loss"])))
 
     def test_delayed_fallback_warns_once(self):
         """Flipping to delayed AFTER init silently used current scaling; now

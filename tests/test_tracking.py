@@ -63,6 +63,7 @@ class TestJsonlTracker:
 
 
 class TestTensorBoardTracker:
+    @pytest.mark.slow
     def test_real_event_dir(self, tmp_path):
         t = TensorBoardTracker("run", tmp_path)
         t.store_init_configuration({"lr": 0.1, "label": "x"})
