@@ -254,10 +254,26 @@ class TrainEngine:
         # express). Only usable when the loss comes from the model itself —
         # a user loss_fn would be silently ignored by the manual path.
         self._manual_vag = None
+        self._manual_vag_wants_rng = False
         if model.loss_fn is None:
             getter = getattr(model.definition, "pipeline_value_and_grad", None)
             if getter is not None:
                 self._manual_vag = getter()
+                # dropout models need the per-step key threaded into the
+                # schedule (per-(stage, microbatch) masks); gate on BOTH the
+                # config needing it and the hook's signature accepting it, so
+                # duck-typed hooks without an rng parameter keep working
+                import inspect
+
+                wants = (
+                    getattr(getattr(model.definition, "config", None), "dropout_rate", 0) > 0
+                )
+                if wants:
+                    try:
+                        wants = "rng" in inspect.signature(self._manual_vag).parameters
+                    except (TypeError, ValueError):
+                        wants = False
+                self._manual_vag_wants_rng = wants
 
     # ------------------------------------------------------------------
     # model apply plumbing
@@ -300,12 +316,16 @@ class TrainEngine:
             if labels is not None:
                 # scale seeds the manual backward (scaled-domain grads, same
                 # underflow protection as the AD path below), then unscale
-                # before the finite check. scale= is passed only when loss
-                # scaling is on: the hook is duck-typed, and a 3-arg
-                # implementation keeps working without fp16.
+                # before the finite check. scale=/rng= are passed only when
+                # needed: the hook is duck-typed, and a 3-arg implementation
+                # keeps working without fp16/dropout.
+                extra = {}
+                if scale is not None:
+                    extra["scale"] = scale
+                if self._manual_vag_wants_rng and rng_key is not None:
+                    extra["rng"] = rng_key
                 loss, grads = self._manual_vag(
-                    self._cast_params(params), ids, labels,
-                    **({"scale": scale} if scale is not None else {}),
+                    self._cast_params(params), ids, labels, **extra
                 )
                 loss = loss.astype(jnp.float32)
                 if scale is not None:
@@ -732,12 +752,14 @@ class TrainEngine:
                     # scale seeds the manual backward's cotangent, so the
                     # whole backward runs scaled (fp16 underflow protection,
                     # same as AD) and grads arrive scaled for the post-scan
-                    # /scale + finite check. scale= only when scaling is on
+                    # /scale + finite check. scale=/rng= only when needed
                     # (duck-typed hook: 3-arg implementations stay valid).
-                    l, g = manual_vag(
-                        self._cast_params(params), ids, labels,
-                        **({"scale": scale} if scale is not None else {}),
-                    )
+                    extra = {}
+                    if scale is not None:
+                        extra["scale"] = scale
+                    if self._manual_vag_wants_rng:
+                        extra["rng"] = sub
+                    l, g = manual_vag(self._cast_params(params), ids, labels, **extra)
                     l = l.astype(jnp.float32)
                     new_es = es
                 else:

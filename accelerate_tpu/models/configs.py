@@ -110,11 +110,6 @@ class DecoderConfig:
                 f"pipeline_schedule must be 'gpipe' or '1f1b', got "
                 f"{self.pipeline_schedule!r}"
             )
-        if self.pipeline_schedule == "1f1b" and self.dropout_rate > 0:
-            raise NotImplementedError(
-                "the 1f1b manual backward does not thread dropout rngs "
-                "through the stage remat; use gpipe or dropout_rate=0"
-            )
         if self.moe_num_experts == 1:
             raise ValueError("moe_num_experts must be 0 (dense) or >= 2")
         if self.moe_num_experts > 1 and not (1 <= self.moe_top_k <= self.moe_num_experts):

@@ -282,20 +282,24 @@ class DecoderBlock(nn.Module):
 
 
 class _ScanBlock(nn.Module):
-    """DecoderBlock adapted to lax.scan carry protocol."""
+    """DecoderBlock adapted to lax.scan carry protocol. ``deterministic``
+    is a STATIC module attribute, not a carry leaf — in the carry it would
+    trace to bool[] and nn.Dropout's python branch cannot take a tracer
+    (latent until dropout_rate > 0 met scan_layers)."""
 
     config: DecoderConfig
     mesh: Optional[Mesh] = None
     use_cache: bool = False
     decode: bool = False
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, carry, _):
-        x, aux, sin, cos, deterministic = carry
+        x, aux, sin, cos = carry
         x, block_aux = DecoderBlock(self.config, self.mesh, self.use_cache, self.decode, name="block")(
-            x, sin, cos, deterministic
+            x, sin, cos, self.deterministic
         )
-        return (x, aux + block_aux, sin, cos, deterministic), None
+        return (x, aux + block_aux, sin, cos), None
 
 
 class StageStack(nn.Module):
@@ -318,9 +322,9 @@ class StageStack(nn.Module):
             length=cfg.num_layers // cfg.pipeline_stages,
             metadata_params={nn.PARTITION_NAME: "layer"},
         )
-        (x, _, _, _, _), _ = Stack(cfg, self.mesh, name="layers")(
-            (x, jnp.float32(0.0), sin, cos, deterministic), None
-        )
+        (x, _, _, _), _ = Stack(
+            cfg, self.mesh, deterministic=deterministic, name="layers"
+        )((x, jnp.float32(0.0), sin, cos), None)
         return x
 
 
@@ -412,9 +416,9 @@ class DecoderLM(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layer"},
             )
-            (x, moe_aux, _, _, _), _ = ScanStack(cfg, self.mesh, use_cache, decode, name="layers")(
-                (x, jnp.float32(0.0), sin, cos, deterministic), None
-            )
+            (x, moe_aux, _, _), _ = ScanStack(
+                cfg, self.mesh, use_cache, decode, deterministic, name="layers"
+            )((x, jnp.float32(0.0), sin, cos), None)
         else:
             block_cls = _maybe_streaming(DecoderBlock, cfg)
             if cfg.remat:
@@ -483,11 +487,14 @@ class DecoderLM(nn.Module):
         else:
             cfg_staged = dataclasses.replace(cfg, pipeline_stages=num_stages)
 
-        def value_and_grad(params, input_ids, labels, scale=None):
+        def value_and_grad(params, input_ids, labels, scale=None, rng=None):
             # ``scale`` (fp16 loss scale) seeds the head-vjp cotangent so the
             # whole manual backward — head, stages, embedding — runs in the
             # scaled domain, matching AD's underflow protection. Grads are
             # returned SCALED; the caller divides by ``scale`` afterwards.
+            # ``rng`` enables dropout: the scheduler gives each (stage,
+            # microbatch) one key, used identically by its forward and its
+            # remat backward (Megatron per-microbatch RNG parity).
             b, s = input_ids.shape
             M = _adapt_microbatches(
                 b, cfg_staged.pipeline_microbatches or num_stages, num_stages
@@ -508,10 +515,21 @@ class DecoderLM(nn.Module):
                 x = _embed_lookup(outer_p["embedding"], ids, cfg, mesh)
                 return split_microbatches(x, M)
 
-            def stage_fn(p_s, x):
-                return StageStack(cfg_staged, mesh).apply(
-                    {"params": p_s}, x, sin, cos, True
-                )
+            with_dropout = cfg.dropout_rate > 0 and rng is not None
+
+            if with_dropout:
+
+                def stage_fn(p_s, x, key):
+                    return StageStack(cfg_staged, mesh).apply(
+                        {"params": p_s}, x, sin, cos, False,
+                        rngs={"dropout": key},
+                    )
+            else:
+
+                def stage_fn(p_s, x):
+                    return StageStack(cfg_staged, mesh).apply(
+                        {"params": p_s}, x, sin, cos, True
+                    )
 
             def make_dy(m, y):
                 tgt = jax.lax.dynamic_index_in_dim(labels_mb, m, 0, keepdims=False)
@@ -537,6 +555,7 @@ class DecoderLM(nn.Module):
             aux, stage_grads, dx_mb = one_f_one_b(
                 stage_fn, stage_params, x_mb, make_dy,
                 num_stages=num_stages, num_microbatches=M, mesh=mesh,
+                rng=rng if with_dropout else None,
             )
             # embedding backward: re-run the (cheap) embed under vjp and pull
             # the pipeline-input cotangents through it

@@ -256,14 +256,19 @@ class _DecoderBlock(nn.Module):
 
 
 class _EncScanBlock(nn.Module):
+    # deterministic is a STATIC attribute, not a carry leaf: in the carry it
+    # traces to bool[] and nn.Dropout's python branch rejects tracers
     config: Seq2SeqConfig
     mesh: Optional[Mesh] = None
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, carry, _):
-        x, sin, cos, kv_mask, deterministic = carry
-        x = _EncoderBlock(self.config, self.mesh, name="block")(x, sin, cos, kv_mask, deterministic)
-        return (x, sin, cos, kv_mask, deterministic), None
+        x, sin, cos, kv_mask = carry
+        x = _EncoderBlock(self.config, self.mesh, name="block")(
+            x, sin, cos, kv_mask, self.deterministic
+        )
+        return (x, sin, cos, kv_mask), None
 
 
 class _DecScanBlock(nn.Module):
@@ -271,14 +276,15 @@ class _DecScanBlock(nn.Module):
     mesh: Optional[Mesh] = None
     use_cache: bool = False
     decode: bool = False
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, carry, _):
-        x, enc, sin, cos, enc_mask, deterministic = carry
+        x, enc, sin, cos, enc_mask = carry
         x = _DecoderBlock(self.config, self.mesh, self.use_cache, self.decode, name="block")(
-            x, enc, sin, cos, enc_mask, deterministic
+            x, enc, sin, cos, enc_mask, self.deterministic
         )
-        return (x, enc, sin, cos, enc_mask, deterministic), None
+        return (x, enc, sin, cos, enc_mask), None
 
 
 def _stack(body_cls, cfg, length, use_cache=False):
@@ -305,9 +311,9 @@ class _Encoder(nn.Module):
     def __call__(self, x, sin, cos, kv_mask, deterministic):
         cfg = self.config
         Stack = _stack(_EncScanBlock, cfg, cfg.num_layers)
-        (x, _, _, _, _), _ = Stack(cfg, self.mesh, name="layers")(
-            (x, sin, cos, kv_mask, deterministic), None
-        )
+        (x, _, _, _), _ = Stack(
+            cfg, self.mesh, deterministic=deterministic, name="layers"
+        )((x, sin, cos, kv_mask), None)
         return x
 
 
@@ -324,9 +330,9 @@ class _Decoder(nn.Module):
                  use_cache: bool = False, decode: bool = False):
         cfg = self.config
         Stack = _stack(_DecScanBlock, cfg, cfg.num_decoder_layers, use_cache=use_cache)
-        (x, _, _, _, _, _), _ = Stack(
-            cfg, self.mesh, use_cache, decode, name="layers"
-        )((x, enc, sin, cos, enc_mask, deterministic), None)
+        (x, _, _, _, _), _ = Stack(
+            cfg, self.mesh, use_cache, decode, deterministic, name="layers"
+        )((x, enc, sin, cos, enc_mask), None)
         return x
 
 

@@ -147,6 +147,7 @@ def one_f_one_b(
     num_microbatches: int,
     mesh: Optional[Mesh] = None,
     buffer_logical_axes: tuple = ("stage", "batch", "seq", "embed"),
+    rng: Optional[jax.Array] = None,
 ):
     """Pipelined value-and-grad with the 1F1B (PipeDream-flush) schedule,
     lock-step SPMD form: every tick, each stage runs ONE forward on its
@@ -173,9 +174,13 @@ def one_f_one_b(
     Args:
       stage_fn: ``(params_one_stage, x) -> y`` with ``y.shape == x.shape``
         (one pipeline stage, NOT stage-vmapped; closures carry consts).
-        Must be deterministic — dropout inside stages is not supported by
-        the manual backward (the flagship decoder trains with
-        dropout_rate=0).
+        With ``rng``, the signature is ``(params, x, key) -> y`` and the
+        stage may consume randomness (dropout): the schedule derives one
+        key per (stage, microbatch) — ``fold_in(rng, s*M + m)`` — and hands
+        the SAME key to that pair's forward and its remat backward, so the
+        recomputed dropout masks match (the Megatron per-microbatch RNG
+        state approach, reference megatron_lm.py:926-1033 context). Without
+        ``rng``, stage_fn must be deterministic.
       stage_params: pytree with leading stage dim ``S`` on every leaf.
       x_mb: ``[M, mb, ...]`` microbatched pipeline inputs (see
         ``split_microbatches``).
@@ -212,13 +217,30 @@ def one_f_one_b(
     def _cx(xm):  # [M, mb...]
         return constrain_activation(xm, (None,) + buffer_logical_axes[1:], mesh)
 
-    stage_fwd = jax.vmap(stage_fn)
+    if rng is None:
+        stage_fwd = jax.vmap(stage_fn)
 
-    def stage_bwd(p, x, ct):
-        _, vjp = jax.vjp(stage_fn, p, x)
-        return vjp(ct)
+        def stage_bwd(p, x, ct):
+            _, vjp = jax.vjp(stage_fn, p, x)
+            return vjp(ct)
 
-    stage_bwd = jax.vmap(stage_bwd)
+        stage_bwd = jax.vmap(stage_bwd)
+        _mb_keys = None
+    else:
+        stage_fwd = jax.vmap(stage_fn)  # (p, x, key) per stage
+
+        def stage_bwd(p, x, ct, key):
+            _, vjp = jax.vjp(lambda pp, xx: stage_fn(pp, xx, key), p, x)
+            return vjp(ct)
+
+        stage_bwd = jax.vmap(stage_bwd)
+
+        def _mb_keys(mbs):
+            # one key per (stage, microbatch); invalid (fill/drain) slots
+            # clamp — their results are masked/discarded downstream
+            return jax.vmap(
+                lambda s, m: jax.random.fold_in(rng, s * M + jnp.clip(m, 0, M - 1))
+            )(jnp.arange(S), mbs)
 
     mb_struct = jax.eval_shape(lambda x: x[0], x_mb)
     aux_struct, dy_struct = jax.eval_shape(
@@ -242,7 +264,11 @@ def one_f_one_b(
             lambda st, v: jax.lax.dynamic_update_index_in_dim(st, v, t % K, 0)
         )(stash, buffer)
         stash = _cstash(stash)
-        y = _cb(stage_fwd(stage_params, buffer))
+        if rng is None:
+            y = _cb(stage_fwd(stage_params, buffer))
+        else:
+            # stage s forwards microbatch t - s this tick
+            y = _cb(stage_fwd(stage_params, buffer, _mb_keys(t - jnp.arange(S))))
 
         # last stage just finished microbatch t-(S-1): loss + fresh cotangent
         # (re-constrain the slice so the head computes on the microbatch's
@@ -269,8 +295,13 @@ def one_f_one_b(
         )
 
         # ---- backward: remat each stage's forward from the stashed input ----
-        dp, dx = stage_bwd(stage_params, _cb(x_b), cot)
         b_idx = t - (2 * S - 1 - jnp.arange(S))
+        if rng is None:
+            dp, dx = stage_bwd(stage_params, _cb(x_b), cot)
+        else:
+            # the SAME per-(stage, microbatch) key its forward used, so the
+            # rematerialized dropout masks match
+            dp, dx = stage_bwd(stage_params, _cb(x_b), cot, _mb_keys(b_idx))
         bwd_valid = jnp.logical_and(b_idx >= 0, b_idx < M)
 
         def _acc(g, d):

@@ -423,10 +423,95 @@ class TestOneFOneB:
         cfg2 = dataclasses.replace(_cfg(), pipeline_schedule="1f1b")
         assert DecoderLM(cfg2).pipeline_value_and_grad() is None
 
-    def test_1f1b_rejects_dropout(self):
-        with pytest.raises(NotImplementedError, match="dropout"):
-            _cfg(num_layers=4, pipeline_stages=2, pipeline_schedule="1f1b",
-                 dropout_rate=0.1)
+    def test_1f1b_dropout_matches_sequential_reference(self):
+        """Dropout in 1F1B (round-4 weak #5, Megatron per-microbatch RNG
+        parity): the schedule derives one key per (stage, microbatch) and
+        reuses it in the remat backward. Grads must equal an AD reference
+        that runs the stages SEQUENTIALLY with the same key derivation —
+        which can only hold if each pair's forward and backward sampled the
+        same masks."""
+        import dataclasses
+
+        from accelerate_tpu.models.decoder import (
+            StageStack,
+            _embed_lookup,
+            _head_ce_loss,
+        )
+        from accelerate_tpu.ops.layers import rotary_embedding_tables
+        from accelerate_tpu.parallel.sharding import unbox_params
+        from accelerate_tpu.parallel.pipeline import split_microbatches
+
+        S, M = 2, 2
+        cfg = dataclasses.replace(
+            _cfg(num_layers=4), pipeline_stages=S, pipeline_microbatches=M,
+            pipeline_schedule="1f1b", dropout_rate=0.2, remat=False,
+            dtype=jnp.float32,
+        )
+        model = DecoderLM(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(11), (4, 16), 0, cfg.vocab_size)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32))
+        params, _ = unbox_params(variables["params"])
+        vag = model.pipeline_value_and_grad()
+        key = jax.random.PRNGKey(42)
+        l, g = jax.jit(lambda p: vag(p, ids, ids, rng=key))(params)
+
+        def ref_loss(p):
+            outer = {k: v for k, v in p.items() if k != "pipeline"}
+            stages = p["pipeline"]["schedule"]["stages"]
+            x = _embed_lookup(outer["embedding"], ids, cfg, None)
+            x_mb = split_microbatches(x, M)
+            labels_mb = split_microbatches(ids, M)
+            counts = jnp.sum(labels_mb[:, :, 1:] != -100, axis=(1, 2)).astype(jnp.float32)
+            weights = counts / jnp.maximum(jnp.sum(counts), 1.0)
+            sin, cos = rotary_embedding_tables(
+                jnp.arange(16), cfg.head_dim, theta=cfg.rope_theta, dtype=cfg.dtype
+            )
+            total = jnp.float32(0.0)
+            for m in range(M):
+                xm = x_mb[m]
+                for st in range(S):
+                    k_sm = jax.random.fold_in(key, st * M + m)
+                    p_s = jax.tree_util.tree_map(lambda v: v[st], stages)
+                    xm = StageStack(cfg, None).apply(
+                        {"params": p_s}, xm, sin, cos, False,
+                        rngs={"dropout": k_sm},
+                    )
+                total = total + _head_ce_loss(
+                    xm, outer["ln_final"], outer["embedding"], outer.get("lm_head"),
+                    labels_mb[m], cfg, None, weight=weights[m],
+                )
+            return total
+
+        ref_l, ref_g = jax.jit(jax.value_and_grad(ref_loss))(params)
+        np.testing.assert_allclose(float(l), float(ref_l), rtol=2e-5)
+        fr, f1 = _flat(ref_g), _flat(g)
+        assert set(fr) == set(f1)
+        for k in fr:
+            a = np.asarray(fr[k], np.float32)
+            b = np.asarray(f1[k], np.float32)
+            err = np.abs(a - b).max() / (np.abs(a).max() + 1e-8)
+            assert err < 2e-4, (k, err)
+
+    def test_1f1b_dropout_without_rng_is_deterministic(self):
+        """No rng passed -> the schedule runs deterministic stages even for
+        a dropout-configured model (eval semantics, old behavior)."""
+        import dataclasses
+
+        from accelerate_tpu.parallel.sharding import unbox_params
+
+        cfg = dataclasses.replace(
+            _cfg(num_layers=4), pipeline_stages=2, pipeline_microbatches=2,
+            pipeline_schedule="1f1b", dropout_rate=0.2, remat=False,
+            dtype=jnp.float32,
+        )
+        model = DecoderLM(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0, cfg.vocab_size)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32))
+        params, _ = unbox_params(variables["params"])
+        vag = model.pipeline_value_and_grad()
+        l1, _ = jax.jit(vag)(params, ids, ids)
+        l2, _ = jax.jit(vag)(params, ids, ids)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=0)
 
     @pytest.mark.slow
     def test_1f1b_peak_activation_below_gpipe(self):
