@@ -255,6 +255,34 @@ class TestFusedStep:
         for k in p_seq:
             np.testing.assert_allclose(p_seq[k], p_multi[k], rtol=1e-5, atol=1e-6)
 
+    def test_replica_wire_bytes_orders_configs(self):
+        """PowerSGD must beat the dtype hop must beat fp32 on the wire, and
+        the arithmetic must mirror the step's eligibility rules."""
+        from accelerate_tpu.accelerator import TrainEngine
+
+        params = {
+            "w": np.zeros((256, 128), np.float32),       # eligible
+            "stack": np.zeros((4, 128, 64), np.float32),  # per-slice eligible
+            "ln": np.zeros((128,), np.float32),           # vector: dtype hop
+            "tiny": np.zeros((8, 8), np.float32),         # min dim <= 2r
+        }
+        none = TrainEngine.replica_wire_bytes(params)
+        bf16 = TrainEngine.replica_wire_bytes(params, "bfloat16")
+        int8 = TrainEngine.replica_wire_bytes(params, "int8")
+        psgd = TrainEngine.replica_wire_bytes(params, None, 4)
+        total = sum(int(np.prod(v.shape)) for v in params.values())
+        assert none["bytes"] == total * 4
+        assert bf16["bytes"] == total * 2
+        assert int8["bytes"] == total * 1 + 4 * len(params)
+        expect = (
+            (256 + 128) * 4 * 4          # w: P+Q fp32 at rank 4
+            + 4 * (128 + 64) * 4 * 4     # stack: per dim-0 slice
+            + (128 + 8 * 8) * 4          # ln + tiny at fp32
+        )
+        assert psgd["bytes"] == expect, (psgd, expect)
+        assert psgd["compressed_leaves"] == 2 and psgd["total_leaves"] == 4
+        assert psgd["bytes"] < bf16["bytes"] < none["bytes"]
+
     def test_steps_per_call_rejected_with_compression(self):
         from accelerate_tpu.state import AcceleratorState
         from accelerate_tpu.utils.dataclasses import ShardingConfig
