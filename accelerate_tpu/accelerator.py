@@ -1153,20 +1153,31 @@ class TrainEngine:
         rank/dtype choice concrete (the reference documents its powerSGD
         hook's tradeoffs qualitatively, utils/dataclasses.py:111-130; this
         quantifies them for YOUR param tree). Mirrors the compressed step's
-        per-leaf routing exactly: PowerSGD-eligible leaves (>=2D,
-        min(m, n) > 2r, stacked leaves per dim-0 slice) send the rank-r P
-        and Q factors in fp32; everything else sends the leaf at the dtype
-        hop's width (int8 adds one fp32 scale per leaf). Returns
+        per-leaf ROUTING (shared _powersgd_matrix_view): PowerSGD-eligible
+        leaves (>=2D, min(m, n) > 2r, stacked leaves per dim-0 slice) send
+        the rank-r P and Q factors in fp32; everything else sends the leaf
+        at the dtype hop's width (int8 adds one fp32 scale per leaf).
+
+        Byte counts assume the replicated intra-slice layout PowerSGD
+        targets (fsdp == 1). On a hybrid fsdp>1 mesh, per-DEVICE traffic
+        differs: fsdp-sharded leaves send 1/fsdp shares while replicated
+        small leaves are reduced from every mesh position — use the
+        Accelerator method, which reports the active config, and treat
+        hybrid numbers as the aggregate across the fsdp group. Returns
         {"bytes": int, "compressed_leaves": int, "total_leaves": int}."""
         from .utils.serialization import flatten_pytree
 
         rank = grad_compression_rank
         comp = grad_compression_dtype
-        if comp in ("bf16",):
-            comp = "bfloat16"
-        if comp in ("fp16",):
-            comp = "float16"
-        dtype_width = {None: 4, "bfloat16": 2, "float16": 2, "int8": 1}[comp]
+        aliases = {"bf16": "bfloat16", "fp16": "float16", "none": None}
+        comp = aliases.get(comp, comp)
+        widths = {None: 4, "bfloat16": 2, "float16": 2, "int8": 1}
+        if comp not in widths:
+            raise ValueError(
+                f"grad_compression_dtype {comp!r} not recognized; pick one of "
+                "None/'bfloat16'/'float16'/'int8' (aliases bf16/fp16/none)"
+            )
+        dtype_width = widths[comp]
         total = 0
         n_comp = 0
         n_leaves = 0

@@ -127,9 +127,15 @@ def _remat_policy(cfg):
     """jax.checkpoint policy for the block remat. "save_attention" keeps the
     flash kernel's named residuals (ops/attention.py checkpoint_name) so the
     backward pass reuses out/lse instead of re-running the kernel — the
-    dominant recompute term at long context."""
-    if getattr(cfg, "remat_policy", "full") == "save_attention":
+    dominant recompute term at long context. "save_dots" additionally keeps
+    every matmul output (dots_with_no_batch_dims_saveable): the backward
+    recomputes only elementwise ops — more HBM than save_attention, fewer
+    recomputed FLOPs; the right trade when activations fit."""
+    policy = getattr(cfg, "remat_policy", "full")
+    if policy == "save_attention":
         return jax.checkpoint_policies.save_only_these_names("flash_out", "flash_lse")
+    if policy == "save_dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     return None
 
 

@@ -84,6 +84,11 @@ class Seq2SeqConfig:
             raise ValueError(
                 f"fp8_recipe must be 'current' or 'delayed', got {self.fp8_recipe!r}"
             )
+        if self.remat_policy not in ("save_attention", "save_dots", "full"):
+            raise ValueError(
+                f"remat_policy must be 'save_attention', 'save_dots' or "
+                f"'full', got {self.remat_policy!r}"
+            )
         if self.num_decoder_layers is None:
             self.num_decoder_layers = self.num_layers
         if self.max_cache_len is None:

@@ -70,6 +70,30 @@ class TestDecoderLM:
 
 
 class TestDecoderTraining:
+    def test_remat_policies_produce_same_grads(self):
+        """save_dots (bench flagship policy) and save_attention change only
+        WHAT the backward recomputes — grads must match exactly."""
+        import dataclasses
+
+        from accelerate_tpu.parallel.sharding import unbox_params
+
+        base = DecoderConfig.tiny(remat=True)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, base.vocab_size)
+        grads = {}
+        for pol in ("save_attention", "save_dots", "full"):
+            cfg = dataclasses.replace(base, remat_policy=pol)
+            model = DecoderLM(cfg)
+            variables = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 32), jnp.int32))
+            params, _ = unbox_params(variables["params"])
+            _, g = jax.jit(jax.value_and_grad(
+                lambda p: model.apply({"params": p}, ids, labels=ids)["loss"]
+            ))(params)
+            grads[pol] = g
+        for pol in ("save_dots", "full"):
+            for a, b in zip(jax.tree_util.tree_leaves(grads["save_attention"]),
+                            jax.tree_util.tree_leaves(grads[pol])):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-7)
+
     def test_trains_through_accelerator_fsdp_tp_mesh(self):
         sc = ShardingConfig(strategy=ShardingStrategy.FSDP, data_parallel=2, fsdp=2, tensor_parallel=2)
         accelerator = Accelerator(sharding_config=sc)
