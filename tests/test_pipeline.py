@@ -600,3 +600,49 @@ class TestOneFOneB:
         assert abs(l0g - l0f) < 1e-3, (l0g, l0f)
         assert abs(gng - gnf) / max(gng, 1e-6) < 1e-2, (gng, gnf)
         assert l1f < l0f  # it actually trains
+
+
+@pytest.mark.slow
+class TestScheduleComposition:
+    def test_fp16_1f1b_dropout_steps_per_call_compose(self):
+        """The four hardest engine features in ONE program: fp16 loss
+        scaling (scaled manual cotangent), the 1F1B schedule, per-(stage,
+        microbatch) dropout keys, and the fused K-step scan. Finite,
+        decreasing, and loss_mean present."""
+        import dataclasses
+
+        import optax
+
+        from accelerate_tpu import Accelerator, Model
+        from accelerate_tpu.state import (
+            AcceleratorState,
+            GradientState,
+            PartialState,
+        )
+        from accelerate_tpu.utils.dataclasses import ShardingConfig
+
+        AcceleratorState._reset_state()
+        PartialState._reset_state()
+        GradientState._reset_state()
+        acc = Accelerator(
+            mixed_precision="fp16",
+            sharding_config=ShardingConfig(pipeline_parallel=2, data_parallel=4),
+        )
+        cfg = dataclasses.replace(
+            _cfg(num_layers=4, max_seq_len=32), dtype=jnp.float32,
+            dropout_rate=0.2, remat=False, pipeline_stages=2,
+            pipeline_microbatches=2, pipeline_schedule="1f1b",
+        )
+        mdef = DecoderLM(cfg, mesh=acc.mesh)
+        v = mdef.init_variables(jax.random.PRNGKey(0), batch_size=8, seq_len=32)
+        model, opt = acc.prepare(Model(mdef, v), optax.adam(2e-3))
+        K = 3
+        step = acc.build_train_step(steps_per_call=K)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 64, (K, 8, 32))
+        batch = acc.prepare_for_eval({"input_ids": ids, "labels": ids}, batch_dim=1)
+        m0 = step(batch)
+        l0 = float(jax.device_get(m0["loss"]))
+        assert np.isfinite(float(jax.device_get(m0["loss_mean"])))
+        l1 = float(jax.device_get(step(batch)["loss"]))
+        assert np.isfinite(l1) and l1 < l0, (l0, l1)
