@@ -255,6 +255,31 @@ class TrainEngine:
         # a user loss_fn would be silently ignored by the manual path.
         self._manual_vag = None
         self._manual_vag_wants_rng = False
+        # model call-signature facts, resolved once: positional parameter
+        # order (binds tuple batches by NAME in _extract_lm_batch) and
+        # whether training should default flax `deterministic` to False —
+        # only when the config actually carries dropout, so models without
+        # it keep bit-identical traces
+        self._call_argnames = ("input_ids", "labels")
+        self._train_dropout_default = False
+        self._det_argpos = -1
+        if model.is_flax:
+            import inspect
+
+            try:
+                sig_params = inspect.signature(model.definition.__call__).parameters
+                self._call_argnames = tuple(sig_params)
+                self._train_dropout_default = (
+                    "deterministic" in sig_params
+                    and getattr(
+                        getattr(model.definition, "config", None), "dropout_rate", 0
+                    )
+                    > 0
+                )
+                if self._train_dropout_default:
+                    self._det_argpos = self._call_argnames.index("deterministic")
+            except (TypeError, ValueError):
+                pass
         if model.loss_fn is None:
             getter = getattr(model.definition, "pipeline_value_and_grad", None)
             if getter is not None:
@@ -282,6 +307,19 @@ class TrainEngine:
     def _apply(self, params, extra_state, training: bool, rng_key, args, kwargs):
         """Pure forward: returns (outputs, new_extra_state)."""
         if self.model.is_flax:
+            # training means dropout: a config with dropout_rate > 0 trains
+            # non-deterministic by default (torch .train() parity) — the same
+            # semantics the manual 1f1b path has, so flipping
+            # pipeline_schedule never toggles regularization. An explicit
+            # deterministic= in the call always wins.
+            if (
+                training
+                and rng_key is not None
+                and self._train_dropout_default
+                and "deterministic" not in kwargs
+                and len(args) <= self._det_argpos  # not already positional
+            ):
+                kwargs = {**kwargs, "deterministic": False}
             variables = {"params": params, **extra_state}
             mutable = list(extra_state.keys()) if (training and extra_state) else False
             rngs = {"dropout": rng_key} if (training and rng_key is not None) else None
@@ -312,7 +350,7 @@ class TrainEngine:
     def _fwd_bwd_fn(self, params, extra_state, scale, rng_key, args, kwargs):
         """outputs + grads in one computation (see module docstring)."""
         if self._manual_vag is not None and not extra_state:
-            ids, labels = _extract_lm_batch(args, kwargs)
+            ids, labels = _extract_lm_batch(args, kwargs, self._call_argnames)
             if labels is not None:
                 # scale seeds the manual backward (scaled-domain grads, same
                 # underflow protection as the AD path below), then unscale
@@ -746,7 +784,7 @@ class TrainEngine:
                 key, sub = jax.random.split(key)
 
                 args, kwargs = _batch_to_call(mb)
-                ids, labels = _extract_lm_batch(args, kwargs)
+                ids, labels = _extract_lm_batch(args, kwargs, self._call_argnames)
                 if manual_vag is not None and not es and labels is not None:
                     # model-owned backward schedule (1f1b pipeline): the loss
                     # scale seeds the manual backward's cotangent, so the
@@ -1314,16 +1352,27 @@ def _batch_to_call(batch):
     return (batch,), {}
 
 
-def _extract_lm_batch(args, kwargs):
-    """(input_ids, labels) from a causal-LM call signature, or (None, None)
-    when the call carries ANYTHING else (positions, deterministic, masks…) —
-    a manual pipeline backward only covers the plain (input_ids, labels)
-    signature, and silently dropping extra inputs would diverge from AD."""
-    if len(args) > 2 or any(k not in ("input_ids", "labels") for k in kwargs):
+def _extract_lm_batch(args, kwargs, argnames=("input_ids", "labels")):
+    """(input_ids, labels) from an LM call, or (None, None) when the call
+    carries ANYTHING else (positions, deterministic, masks…) — a manual
+    pipeline backward only covers the plain (input_ids, labels) signature,
+    and silently dropping extra inputs would diverge from AD.
+
+    ``argnames`` is the MODEL's positional parameter order (taken from its
+    call signature at engine init): positional args are bound by name
+    before the check, so a tuple batch against Seq2SeqLM's
+    (input_ids, decoder_input_ids, ...) signature maps args[1] to
+    decoder_input_ids — and is routed to AD — instead of being misread as
+    labels."""
+    named = {}
+    for i, a in enumerate(args):
+        if i >= len(argnames):
+            return None, None
+        named[argnames[i]] = a
+    named.update(kwargs)
+    if any(k not in ("input_ids", "labels") for k in named):
         return None, None
-    ids = args[0] if args else kwargs.get("input_ids")
-    labels = kwargs.get("labels", args[1] if len(args) > 1 else None)
-    return ids, labels
+    return named.get("input_ids"), named.get("labels")
 
 
 class Accelerator:

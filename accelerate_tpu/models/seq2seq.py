@@ -78,11 +78,25 @@ class Seq2SeqConfig:
     use_fp8: bool = False
     fp8_recipe: str = "current"
     fp8_amax_history_len: int = 16
+    # pipeline parallelism over the DECODER tower (the deeper side of a
+    # T5-family model; the encoder runs under plain AD, its batch sharded
+    # over the data axes and its params replicated over "stage"). Stages
+    # carry a packed [target; memory] belt so the encoder output rides the
+    # same neighbor collective-permutes as the activations and its
+    # cotangent flows back to the encoder through the schedule's dx.
+    pipeline_stages: int = 1
+    pipeline_microbatches: Optional[int] = None
+    pipeline_schedule: str = "gpipe"  # "gpipe" (AD) | "1f1b" (O(S) stash)
 
     def __post_init__(self):
         if self.fp8_recipe not in ("current", "delayed"):
             raise ValueError(
                 f"fp8_recipe must be 'current' or 'delayed', got {self.fp8_recipe!r}"
+            )
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pipeline_schedule must be 'gpipe' or '1f1b', "
+                f"got {self.pipeline_schedule!r}"
             )
         if self.remat_policy not in ("save_attention", "save_dots", "full"):
             raise ValueError(
@@ -91,6 +105,12 @@ class Seq2SeqConfig:
             )
         if self.num_decoder_layers is None:
             self.num_decoder_layers = self.num_layers
+        if self.pipeline_stages > 1:
+            if self.num_decoder_layers % self.pipeline_stages != 0:
+                raise ValueError(
+                    f"num_decoder_layers={self.num_decoder_layers} is not "
+                    f"divisible by pipeline_stages={self.pipeline_stages}"
+                )
         if self.max_cache_len is None:
             self.max_cache_len = self.max_target_len
         if self.num_kv_heads is None:
@@ -308,6 +328,52 @@ def _stack(body_cls, cfg, length, use_cache=False):
     )
 
 
+def _effective_stages(cfg: "Seq2SeqConfig", mesh: Optional[Mesh]) -> int:
+    """Decoder-tower pipeline degree: explicit config wins; otherwise a mesh
+    with a real "stage" axis (ShardingConfig(pipeline_parallel=k)) turns the
+    pipeline path on automatically (DecoderLM._effective_stages analog)."""
+    if cfg.pipeline_stages > 1:
+        return cfg.pipeline_stages
+    if (
+        mesh is not None
+        and mesh.shape.get("stage", 1) > 1
+        and cfg.num_decoder_layers % mesh.shape["stage"] == 0
+    ):
+        return mesh.shape["stage"]
+    return 1
+
+
+class Seq2SeqStageStack(nn.Module):
+    """One decoder-tower pipeline stage over the packed belt.
+
+    The belt slice is ``[mb, target_len + enc_len, E]``: decoder hidden
+    states in front, the encoder output ("memory") behind. Each stage runs
+    its ``num_decoder_layers / pipeline_stages`` blocks on the front part
+    with cross-attention into the back part, then re-packs — memory passes
+    through unchanged, so it hands forward along the stage belt as the same
+    neighbor collective-permute as the activations, and under AD (or the
+    1F1B scheduler's per-stage vjp) its cotangent accumulates every stage's
+    cross-attention contribution on the way back to the encoder.
+    ``enc_mask`` is per-microbatch (PipelineStages ``num_mb_consts=1``)."""
+
+    config: Seq2SeqConfig
+    mesh: Optional[Mesh] = None
+    target_len: int = 0
+
+    @nn.compact
+    def __call__(self, buf, sin, cos, deterministic, enc_mask=None):
+        cfg = self.config
+        x = buf[:, : self.target_len, :]
+        mem = buf[:, self.target_len :, :]
+        Stack = _stack(
+            _DecScanBlock, cfg, cfg.num_decoder_layers // cfg.pipeline_stages
+        )
+        (x, _, _, _, _), _ = Stack(
+            cfg, self.mesh, False, False, deterministic, name="layers"
+        )((x, mem, sin, cos, enc_mask), None)
+        return jnp.concatenate([x, mem], axis=1)
+
+
 class _Encoder(nn.Module):
     config: Seq2SeqConfig
     mesh: Optional[Mesh] = None
@@ -325,7 +391,13 @@ class _Encoder(nn.Module):
 class _Decoder(nn.Module):
     """use_cache/decode arrive as CALL args (Python statics): the scanned
     block is constructed per call with the flags but pinned to name="layers",
-    so prefill / decode-step / training all share one param+cache scope."""
+    so prefill / decode-step / training all share one param+cache scope.
+
+    With pipeline stages (explicit ``pipeline_stages`` or a mesh "stage"
+    axis), the tower runs the GPipe schedule over the packed
+    [target; memory] belt instead (Seq2SeqStageStack); cached decode through
+    a pipeline is rejected — fold the stage-stacked layers back first
+    (parallel/pipeline.stages_to_stack_layers)."""
 
     config: Seq2SeqConfig
     mesh: Optional[Mesh] = None
@@ -334,6 +406,55 @@ class _Decoder(nn.Module):
     def __call__(self, x, enc, sin, cos, enc_mask, deterministic,
                  use_cache: bool = False, decode: bool = False):
         cfg = self.config
+        num_stages = _effective_stages(cfg, self.mesh)
+        if num_stages > 1:
+            if use_cache:
+                raise NotImplementedError(
+                    "KV-cache decode through the pipeline schedule is not "
+                    "supported (a decode step is serial across stages by "
+                    "construction); fold the stage-stacked layers back into "
+                    "the layer scan (parallel/pipeline.stages_to_stack_layers) "
+                    "and generate without a stage axis"
+                )
+            if cfg.use_fp8 and cfg.fp8_recipe == "delayed":
+                raise NotImplementedError(
+                    "delayed fp8 scaling + pipeline parallelism is not "
+                    "wired; use fp8_recipe='current'"
+                )
+            import dataclasses as _dc
+
+            from ..parallel.pipeline import (
+                PipelineStages,
+                merge_microbatches,
+                split_microbatches,
+            )
+            from .decoder import _adapt_microbatches
+
+            if cfg.pipeline_stages <= 1:
+                cfg = _dc.replace(cfg, pipeline_stages=num_stages)
+            b, s_dec = x.shape[0], x.shape[1]
+            num_micro = _adapt_microbatches(
+                b, cfg.pipeline_microbatches or num_stages, num_stages
+            )
+            buf_mb = jnp.concatenate(
+                [split_microbatches(x, num_micro), split_microbatches(enc, num_micro)],
+                axis=2,
+            )
+            consts = (sin, cos, deterministic)
+            n_mb_consts = 0
+            if enc_mask is not None:
+                consts = consts + (split_microbatches(enc_mask, num_micro),)
+                n_mb_consts = 1
+            out = PipelineStages(
+                stage_module=Seq2SeqStageStack,
+                stage_args=(cfg, self.mesh, s_dec),
+                num_stages=num_stages,
+                num_microbatches=num_micro,
+                mesh=self.mesh,
+                num_mb_consts=n_mb_consts,
+                name="pipeline",
+            )(buf_mb, *consts)
+            return merge_microbatches(out)[:, :s_dec]
         Stack = _stack(_DecScanBlock, cfg, cfg.num_decoder_layers, use_cache=use_cache)
         (x, _, _, _, _), _ = Stack(
             cfg, self.mesh, use_cache, decode, deterministic, name="layers"
@@ -460,6 +581,167 @@ class Seq2SeqLM(nn.Module):
             ignore_index=-100, num_chunks=cfg.fused_ce_chunks,
         )
         return {"loss": loss}
+
+    def pipeline_value_and_grad(self):
+        """Manual ``(params, input_ids, labels) -> (loss, grads)`` for the
+        1F1B schedule on the DECODER tower
+        (``config.pipeline_schedule == "1f1b"``; DecoderLM analog).
+
+        The encoder runs under plain ``jax.vjp`` (its stash is one
+        [B, T, E] memory — O(1) in microbatches already), the decoder
+        stages run ``parallel/pipeline.one_f_one_b`` over the packed
+        [target; memory] belt, and the memory part of the schedule's input
+        cotangent feeds the encoder backward. Per-microbatch CE means are
+        weighted by valid-token share so the summed loss equals
+        ``__call__``'s global non-ignored-token mean (labels align 1:1 with
+        decoder positions — no shift). Returns None when the schedule is
+        not "1f1b"; the engine only routes plain (input_ids, labels)
+        batches here, so the encoder padding mask is always None — masked
+        batches train through the AD/GPipe path instead."""
+        cfg = self.config
+        mesh = self.mesh
+        num_stages = _effective_stages(cfg, mesh)
+        if cfg.pipeline_schedule != "1f1b" or num_stages <= 1:
+            return None
+        import dataclasses as _dc
+
+        if cfg.pipeline_stages > 1:
+            cfg_staged = cfg
+        else:
+            cfg_staged = _dc.replace(cfg, pipeline_stages=num_stages)
+
+        def value_and_grad(params, input_ids, labels, scale=None, rng=None):
+            # ``scale`` (fp16 loss scale) seeds the head-vjp cotangent so
+            # the whole manual backward — head, stages, memory, encoder,
+            # embeddings — runs in the scaled domain (AD-parity underflow
+            # protection); grads return SCALED, the caller unscales.
+            from ..parallel.pipeline import (
+                merge_microbatches,
+                one_f_one_b,
+                split_microbatches,
+            )
+            from .decoder import _adapt_microbatches
+
+            b, t_enc = input_ids.shape
+            s_dec = labels.shape[1]
+            decoder_input_ids = shift_right(labels, cfg.decoder_start_token_id)
+            M = _adapt_microbatches(
+                b, cfg_staged.pipeline_microbatches or num_stages, num_stages
+            )
+            sin_d, cos_d = rotary_embedding_tables(
+                jnp.arange(s_dec), cfg.head_dim, theta=cfg.rope_theta, dtype=cfg.dtype
+            )
+            sin_e, cos_e = rotary_embedding_tables(
+                jnp.arange(t_enc), cfg.head_dim, theta=cfg.rope_theta, dtype=cfg.dtype
+            )
+
+            stage_params = params["decoder"]["pipeline"]["schedule"]["stages"]
+            enc_side = {
+                "embedding": params["embedding"],
+                "encoder": params["encoder"],
+                "ln_enc": params["ln_enc"],
+            }
+            head_side = {"embedding": params["embedding"], "ln_dec": params["ln_dec"]}
+            if "lm_head" in params:
+                head_side["lm_head"] = params["lm_head"]
+
+            with_dropout = cfg.dropout_rate > 0 and rng is not None
+            det = not with_dropout
+            rng_enc = rng_sched = None
+            if with_dropout:
+                rng_enc, rng_sched = jax.random.split(rng)
+
+            def encode_fn(ep):
+                x = _embed_lookup(ep["embedding"], input_ids, cfg, mesh)
+                kw = {"rngs": {"dropout": rng_enc}} if with_dropout else {}
+                x = _Encoder(cfg, mesh).apply(
+                    {"params": ep["encoder"]}, x, sin_e, cos_e, None, det, **kw
+                )
+                return rms_norm(x, ep["ln_enc"], cfg.norm_eps)
+
+            mem, enc_vjp = jax.vjp(encode_fn, enc_side)
+
+            def dec_embed_fn(emb):
+                return split_microbatches(
+                    _embed_lookup(emb, decoder_input_ids, cfg, mesh), M
+                )
+
+            x_mb = dec_embed_fn(params["embedding"])
+            buf_mb = jnp.concatenate([x_mb, split_microbatches(mem, M)], axis=2)
+
+            labels_mb = split_microbatches(labels, M)
+            counts = jnp.sum(labels_mb != -100, axis=(1, 2)).astype(jnp.float32)
+            weights = counts / jnp.maximum(jnp.sum(counts), 1.0)
+
+            if with_dropout:
+
+                def stage_fn(p_s, buf, key):
+                    return Seq2SeqStageStack(cfg_staged, mesh, s_dec).apply(
+                        {"params": p_s}, buf, sin_d, cos_d, False,
+                        rngs={"dropout": key},
+                    )
+            else:
+
+                def stage_fn(p_s, buf):
+                    return Seq2SeqStageStack(cfg_staged, mesh, s_dec).apply(
+                        {"params": p_s}, buf, sin_d, cos_d, True
+                    )
+
+            def make_dy(m, y):
+                tgt = jax.lax.dynamic_index_in_dim(labels_mb, m, 0, keepdims=False)
+                w = jax.lax.dynamic_index_in_dim(weights, m, 0, keepdims=False)
+
+                def head(hp, yy):
+                    x = rms_norm(yy[:, :s_dec], hp["ln_dec"], cfg.norm_eps)
+                    x = _constrain(x, ("batch", "seq", "embed"), mesh)
+                    kernel = _tied_vocab_kernel(hp["embedding"], hp.get("lm_head"), cfg)
+                    rows = x.shape[0] * x.shape[1]
+                    loss = fused_linear_cross_entropy(
+                        x.reshape(rows, cfg.embed_dim), kernel, tgt.reshape(rows),
+                        ignore_index=-100, num_chunks=cfg.fused_ce_chunks,
+                    )
+                    return loss * w
+
+                loss_m, vjp = jax.vjp(head, head_side, y)
+                seed = jnp.ones((), loss_m.dtype)
+                if scale is not None:
+                    seed = seed * jnp.asarray(scale, loss_m.dtype)
+                dhead, dy = vjp(seed)
+                dhead = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), dhead
+                )
+                return {"loss": loss_m.astype(jnp.float32), "douter": dhead}, dy
+
+            aux, stage_grads, dx_mb = one_f_one_b(
+                stage_fn, stage_params, buf_mb, make_dy,
+                num_stages=num_stages, num_microbatches=M, mesh=mesh,
+                rng=rng_sched if with_dropout else None,
+            )
+            # memory cotangent (every stage's cross-attention contribution,
+            # accumulated down the belt) -> encoder backward; target part ->
+            # decoder-input embedding backward
+            d_mem = merge_microbatches(dx_mb[:, :, s_dec:])
+            (d_enc_side,) = enc_vjp(d_mem.astype(mem.dtype))
+            _, emb_vjp = jax.vjp(dec_embed_fn, params["embedding"])
+            (d_emb_dec,) = emb_vjp(dx_mb[:, :, :s_dec].astype(x_mb.dtype))
+
+            d_enc_side = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), d_enc_side
+            )
+            grads = {
+                "embedding": aux["douter"]["embedding"]
+                + d_enc_side["embedding"]
+                + d_emb_dec.astype(jnp.float32),
+                "encoder": d_enc_side["encoder"],
+                "ln_enc": d_enc_side["ln_enc"],
+                "ln_dec": aux["douter"]["ln_dec"],
+                "decoder": {"pipeline": {"schedule": {"stages": stage_grads}}},
+            }
+            if "lm_head" in head_side:
+                grads["lm_head"] = aux["douter"]["lm_head"]
+            return aux["loss"], grads
+
+        return value_and_grad
 
     def init_variables(self, rng: jax.Array, batch_size: int = 1,
                        seq_len: Optional[int] = None, target_len: Optional[int] = None):

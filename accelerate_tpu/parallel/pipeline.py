@@ -50,6 +50,12 @@ class PipelineStages(nn.Module):
     num_stages: int
     num_microbatches: int
     mesh: Optional[Mesh] = None
+    # how many TRAILING consts are per-microbatch ([M, ...] leading dim)
+    # rather than broadcast: stage s at tick t processes microbatch t-s, so
+    # those consts are gathered per stage by that index each tick (the
+    # seq2seq decoder tower routes its per-microbatch encoder padding mask
+    # this way — a broadcast const cannot follow the belt)
+    num_mb_consts: int = 0
     # logical axes of the [stage, microbatch, ...] activation buffer; callers
     # with non-[b,s,e] stage bodies supply their own
     buffer_logical_axes: tuple = ("stage", "batch", "seq", "embed")
@@ -64,10 +70,14 @@ class PipelineStages(nn.Module):
         steps = pipeline_round_trip_steps(M, S)
         x_microbatches = self._constrain_outputs(x_microbatches)
 
+        n_mb = self.num_mb_consts
+        bcast, mb_consts = (consts, ()) if n_mb == 0 else (consts[:-n_mb], consts[-n_mb:])
+
         # Stage-vmapped module: params [S, ...] with partition name "stage".
+        # Per-microbatch consts arrive pre-gathered with a leading stage dim.
         Stages = nn.vmap(
             self.stage_module,
-            in_axes=(0,) + (None,) * len(consts),
+            in_axes=(0,) + (None,) * len(bcast) + (0,) * n_mb,
             out_axes=0,
             axis_size=S,
             variable_axes={"params": 0},
@@ -77,11 +87,24 @@ class PipelineStages(nn.Module):
 
         outer = self
 
+        def _gather_mb(t):
+            # stage s processes microbatch t-s this tick; fill/drain ticks
+            # clamp (their stage outputs are never collected)
+            idx = jnp.clip(t - jnp.arange(S), 0, M - 1)
+            return tuple(
+                jax.vmap(
+                    lambda i, c=c: jax.lax.dynamic_index_in_dim(c, i, 0, keepdims=False)
+                )(idx)
+                for c in mb_consts
+            )
+
         class _Step(nn.Module):
             @nn.compact
             def __call__(self, carry, t):
                 buffer, outputs = carry
-                y = Stages(*outer.stage_args, name="stages")(buffer, *consts)
+                y = Stages(*outer.stage_args, name="stages")(
+                    buffer, *bcast, *_gather_mb(t)
+                )
                 y = outer._constrain_buffer(y)
                 # the last stage finished microbatch t-(S-1) at this step
                 out_idx = t - (S - 1)
@@ -432,6 +455,14 @@ def remap_params_to_pipeline(dense_params, pipe_params_template, num_stages: int
 
     def _match(pipe_path, template_leaf):
         if "stages/layers/" in pipe_path:
+            # exact positional match first: the pipeline subtree replaces the
+            # dense layer scan in place, so stripping the schedule scaffolding
+            # recovers the dense path. Seq2seq needs this — suffix matching
+            # alone would let a decoder-stage tail (block/mlp/w1) resolve to
+            # the ENCODER's identically-named leaf.
+            exact = pipe_path.replace("pipeline/schedule/stages/layers", "layers")
+            if exact in dense_flat:
+                return jnp.asarray(dense_flat[exact]).reshape(template_leaf.shape)
             tail = pipe_path.split("stages/layers/")[-1]
             for dense_path, dense_leaf in dense_flat.items():
                 if dense_path.endswith(tail) and "layers/" in dense_path:
