@@ -362,10 +362,18 @@ class TrainEngine:
                     extra["scale"] = scale
                 if self._manual_vag_wants_rng and rng_key is not None:
                     extra["rng"] = rng_key
-                loss, grads = self._manual_vag(
+                out, grads = self._manual_vag(
                     self._cast_params(params), ids, labels, **extra
                 )
-                loss = loss.astype(jnp.float32)
+                # hooks return a scalar loss, or an outputs dict with "loss"
+                # (MoE surfaces {"loss","lm_loss","aux_loss"} — same contract
+                # as the AD path's model outputs)
+                outputs = (
+                    {k: v.astype(jnp.float32) for k, v in out.items()}
+                    if isinstance(out, dict)
+                    else {"loss": out.astype(jnp.float32)}
+                )
+                loss = outputs["loss"]
                 if scale is not None:
                     grads = jax.tree_util.tree_map(
                         lambda g: (g.astype(jnp.float32) / scale), grads
@@ -378,7 +386,7 @@ class TrainEngine:
                 else:
                     grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
                     finite = jnp.asarray(True)
-                return {"loss": loss}, extra_state, grads, finite, loss
+                return outputs, extra_state, grads, finite, loss
 
         def loss_of(p):
             outputs, new_state = self._apply(
@@ -797,8 +805,11 @@ class TrainEngine:
                         extra["scale"] = scale
                     if self._manual_vag_wants_rng:
                         extra["rng"] = sub
-                    l, g = manual_vag(self._cast_params(params), ids, labels, **extra)
-                    l = l.astype(jnp.float32)
+                    out, g = manual_vag(self._cast_params(params), ids, labels, **extra)
+                    # dict-returning hooks (MoE) -> the scalar for the scan
+                    l = (out["loss"] if isinstance(out, dict) else out).astype(
+                        jnp.float32
+                    )
                     new_es = es
                 else:
 

@@ -127,11 +127,6 @@ class DecoderConfig:
                 f"moe_top_k={self.moe_top_k} must be in [1, moe_num_experts="
                 f"{self.moe_num_experts}]"
             )
-        if self.moe_num_experts > 1 and self.pipeline_stages > 1:
-            raise NotImplementedError(
-                "MoE + pipeline parallelism in one model is not wired yet "
-                "(the pipeline buffer does not carry the router aux loss)"
-            )
 
     @property
     def num_params(self) -> int:

@@ -328,9 +328,13 @@ class StageStack(nn.Module):
             length=cfg.num_layers // cfg.pipeline_stages,
             metadata_params={nn.PARTITION_NAME: "layer"},
         )
-        (x, _, _, _), _ = Stack(
+        (x, aux, _, _), _ = Stack(
             cfg, self.mesh, deterministic=deterministic, name="layers"
         )((x, jnp.float32(0.0), sin, cos), None)
+        if cfg.moe_num_experts > 1:
+            # per-(stage, microbatch) router load-balance sum over this
+            # stage's layers; the schedule accumulates and renormalizes
+            return x, aux
         return x
 
 
@@ -397,15 +401,22 @@ class DecoderLM(nn.Module):
                 b, cfg.pipeline_microbatches or num_stages, num_stages
             )
             x_mb = split_microbatches(x, num_micro)
-            x = PipelineStages(
+            moe = cfg.moe_num_experts > 1
+            out = PipelineStages(
                 stage_module=StageStack,
                 stage_args=(cfg, self.mesh),
                 num_stages=num_stages,
                 num_microbatches=num_micro,
                 mesh=self.mesh,
+                stage_returns_aux=moe,
                 name="pipeline",
             )(x_mb, sin, cos, deterministic)
-            x = merge_microbatches(x)
+            if moe:
+                out, aux_total = out
+                # sum over (stage, mb) of per-mb means == M x full-batch
+                # mean (even split), so /M recovers the dense-path aux
+                moe_aux = aux_total / num_micro
+            x = merge_microbatches(out)
         elif cfg.scan_layers:
             scan_body = _maybe_streaming(_ScanBlock, cfg)
             if cfg.remat:
@@ -478,12 +489,6 @@ class DecoderLM(nn.Module):
         cfg = self.config
         num_stages = self._effective_stages()
         if cfg.pipeline_schedule != "1f1b" or num_stages <= 1:
-            return None
-        if cfg.moe_num_experts > 1:
-            # MoE pipeline models return {"loss","lm_loss","aux_loss"}; the
-            # manual path's bare {"loss"} would break that contract — fall
-            # back to AD (mesh-auto-enabled pipelines reach here; explicit
-            # pipeline_stages>1 + MoE is already rejected at config time)
             return None
         from ..parallel.pipeline import one_f_one_b, split_microbatches
 
@@ -558,11 +563,27 @@ class DecoderLM(nn.Module):
                 return {"loss": loss_m.astype(jnp.float32), "douter": douter_h}, dy
 
             x_mb = embed_fn(outer, input_ids)
-            aux, stage_grads, dx_mb = one_f_one_b(
+            moe = cfg.moe_num_experts > 1
+            sched_kwargs = {}
+            if moe:
+                # router aux: dense loss carries weight * (sum of per-layer
+                # batch-mean aux) / num_layers; the schedule sums per-mb
+                # means over (stage, mb), so the seed is weight/(layers*M)
+                # — x scale to keep the whole backward in the scaled domain
+                aux_seed = cfg.moe_aux_loss_weight / (cfg.num_layers * M)
+                if scale is not None:
+                    aux_seed = aux_seed * jnp.asarray(scale, jnp.float32)
+                sched_kwargs["stage_aux_weight"] = aux_seed
+            out = one_f_one_b(
                 stage_fn, stage_params, x_mb, make_dy,
                 num_stages=num_stages, num_microbatches=M, mesh=mesh,
                 rng=rng if with_dropout else None,
+                **sched_kwargs,
             )
+            if moe:
+                aux, stage_grads, dx_mb, aux_stage = out
+            else:
+                aux, stage_grads, dx_mb = out
             # embedding backward: re-run the (cheap) embed under vjp and pull
             # the pipeline-input cotangents through it
             _, embed_vjp = jax.vjp(lambda op: embed_fn(op, input_ids), outer)
@@ -573,6 +594,16 @@ class DecoderLM(nn.Module):
             )
             grads = dict(douter)
             grads["pipeline"] = {"schedule": {"stages": stage_grads}}
+            if moe:
+                # same outputs contract as the AD path's MoE model outputs
+                aux_term = cfg.moe_aux_loss_weight * aux_stage / (
+                    cfg.num_layers * M
+                )
+                return {
+                    "loss": aux["loss"] + aux_term,
+                    "lm_loss": aux["loss"],
+                    "aux_loss": aux_term,
+                }, grads
             return aux["loss"], grads
 
         return value_and_grad

@@ -36,6 +36,16 @@ def pipeline_round_trip_steps(num_microbatches: int, num_stages: int) -> int:
     return num_microbatches + num_stages - 1
 
 
+def _accumulate_valid_aux(aux_acc, aux_t, t, num_stages: int, num_microbatches: int):
+    """Add the per-stage aux values for this tick's VALID forwards (stage s
+    forwards microbatch t-s; fill/drain slots are garbage). Shared by the
+    GPipe belt and the 1F1B scheduler so the validity rule cannot
+    desynchronize between the schedules."""
+    f_idx = t - jnp.arange(num_stages)
+    f_valid = jnp.logical_and(f_idx >= 0, f_idx < num_microbatches)
+    return aux_acc + jnp.sum(jnp.where(f_valid, aux_t.astype(jnp.float32), 0.0))
+
+
 class PipelineStages(nn.Module):
     """Runs S copies of ``stage_module`` (one per pipeline stage) over a
     stage-major activation buffer via the GPipe shift schedule.
@@ -56,6 +66,12 @@ class PipelineStages(nn.Module):
     # seq2seq decoder tower routes its per-microbatch encoder padding mask
     # this way — a broadcast const cannot follow the belt)
     num_mb_consts: int = 0
+    # stage_module returns (y, aux_scalar) instead of y (the MoE router
+    # load-balance term): valid (stage, microbatch) aux values accumulate
+    # across ticks and __call__ returns (outputs, aux_total). Reverse-mode
+    # AD differentiates the accumulation, so the router term trains under
+    # the GPipe schedule instead of being silently dropped.
+    stage_returns_aux: bool = False
     # logical axes of the [stage, microbatch, ...] activation buffer; callers
     # with non-[b,s,e] stage bodies supply their own
     buffer_logical_axes: tuple = ("stage", "batch", "seq", "embed")
@@ -101,10 +117,13 @@ class PipelineStages(nn.Module):
         class _Step(nn.Module):
             @nn.compact
             def __call__(self, carry, t):
-                buffer, outputs = carry
+                buffer, outputs, aux_acc = carry
                 y = Stages(*outer.stage_args, name="stages")(
                     buffer, *bcast, *_gather_mb(t)
                 )
+                if outer.stage_returns_aux:
+                    y, aux_t = y
+                    aux_acc = _accumulate_valid_aux(aux_acc, aux_t, t, S, M)
                 y = outer._constrain_buffer(y)
                 # the last stage finished microbatch t-(S-1) at this step
                 out_idx = t - (S - 1)
@@ -120,7 +139,7 @@ class PipelineStages(nn.Module):
                 feed = outer._constrain_slice(jnp.where(t + 1 < M, feed, jnp.zeros_like(feed)))
                 buffer = jnp.concatenate([feed[None], y[:-1]], axis=0)
                 buffer = outer._constrain_buffer(buffer)
-                return (buffer, outputs), None
+                return (buffer, outputs, aux_acc), None
 
         TimeLoop = nn.scan(
             _Step,
@@ -139,9 +158,11 @@ class PipelineStages(nn.Module):
         )
         buffer0 = self._constrain_buffer(buffer0)
         outputs0 = self._constrain_outputs(jnp.zeros_like(x_microbatches))
-        (_, outputs), _ = TimeLoop(name="schedule")(
-            (buffer0, outputs0), jnp.arange(steps)
+        (_, outputs, aux_total), _ = TimeLoop(name="schedule")(
+            (buffer0, outputs0, jnp.float32(0.0)), jnp.arange(steps)
         )
+        if self.stage_returns_aux:
+            return outputs, aux_total
         return outputs
 
     def _constrain_buffer(self, buf):
@@ -171,6 +192,7 @@ def one_f_one_b(
     mesh: Optional[Mesh] = None,
     buffer_logical_axes: tuple = ("stage", "batch", "seq", "embed"),
     rng: Optional[jax.Array] = None,
+    stage_aux_weight: Optional[float] = None,
 ):
     """Pipelined value-and-grad with the 1F1B (PipeDream-flush) schedule,
     lock-step SPMD form: every tick, each stage runs ONE forward on its
@@ -214,6 +236,19 @@ def one_f_one_b(
         **including the caller's microbatch weighting** (e.g. 1/M for a
         mean-of-microbatch-means loss).
 
+    With ``stage_aux_weight`` set, ``stage_fn`` returns ``(y, aux_scalar)``
+    — a per-(stage, microbatch) auxiliary loss (the MoE router
+    load-balance term). The scheduler accumulates the PRIMAL aux over
+    valid (stage, microbatch) pairs, and seeds each stage backward with
+    ``stage_aux_weight`` as the aux cotangent so d(weight * aux_total)
+    flows into both the stage grads and the belt (the router term depends
+    on the stage INPUT too). Under fp16 scaling pass the weight
+    pre-multiplied by the scale — the whole backward runs in the scaled
+    domain. The return grows to
+    ``(aux_sum, stage_grads, dx_mb, stage_aux_total)``; the caller owns
+    normalization (e.g. /M for a mean-of-microbatches) and adding
+    ``weight * stage_aux_total`` to its loss.
+
     Returns ``(aux_sum, stage_grads, dx_mb)``: the summed aux tree, grads
     for ``stage_params`` (same structure, fp32), and the cotangent wrt
     ``x_mb``.
@@ -240,12 +275,19 @@ def one_f_one_b(
     def _cx(xm):  # [M, mb...]
         return constrain_activation(xm, (None,) + buffer_logical_axes[1:], mesh)
 
+    has_aux = stage_aux_weight is not None
+    # may be a traced scalar (fp16 scale folded in by the caller)
+    aux_w = jnp.asarray(stage_aux_weight, jnp.float32) if has_aux else None
+
     if rng is None:
         stage_fwd = jax.vmap(stage_fn)
 
         def stage_bwd(p, x, ct):
             _, vjp = jax.vjp(stage_fn, p, x)
-            return vjp(ct)
+            # (y, aux) functions get the aux-loss cotangent seeded here, so
+            # the router term's gradient lands in dp AND dx (it depends on
+            # the stage input as well)
+            return vjp((ct, aux_w) if has_aux else ct)
 
         stage_bwd = jax.vmap(stage_bwd)
         _mb_keys = None
@@ -254,7 +296,7 @@ def one_f_one_b(
 
         def stage_bwd(p, x, ct, key):
             _, vjp = jax.vjp(lambda pp, xx: stage_fn(pp, xx, key), p, x)
-            return vjp(ct)
+            return vjp((ct, aux_w) if has_aux else ct)
 
         stage_bwd = jax.vmap(stage_bwd)
 
@@ -271,7 +313,7 @@ def one_f_one_b(
     )
 
     def tick(carry, t):
-        buffer, cot, stash, grads, aux, dx_mb = carry
+        buffer, cot, stash, grads, aux, dx_mb, aux_stage = carry
 
         # ---- stash read FIRST: backward inputs for microbatch t-(2S-1-s)
         # at stage s, stashed at tick b+s = t-(2S-1)+2s. For stage 0 that
@@ -288,10 +330,14 @@ def one_f_one_b(
         )(stash, buffer)
         stash = _cstash(stash)
         if rng is None:
-            y = _cb(stage_fwd(stage_params, buffer))
+            y = stage_fwd(stage_params, buffer)
         else:
             # stage s forwards microbatch t - s this tick
-            y = _cb(stage_fwd(stage_params, buffer, _mb_keys(t - jnp.arange(S))))
+            y = stage_fwd(stage_params, buffer, _mb_keys(t - jnp.arange(S)))
+        if has_aux:
+            y, aux_t = y
+            aux_stage = _accumulate_valid_aux(aux_stage, aux_t, t, S, M)
+        y = _cb(y)
 
         # last stage just finished microbatch t-(S-1): loss + fresh cotangent
         # (re-constrain the slice so the head computes on the microbatch's
@@ -349,7 +395,7 @@ def one_f_one_b(
         # the microbatch it backwards next tick; the fresh last-stage slot
         # is this tick's loss cotangent (mb t-(S-1), backwarded at t+1)
         cot = _cb(jnp.concatenate([dx[1:], dy_t[None]], axis=0))
-        return (buffer, cot, stash, grads, aux, dx_mb), None
+        return (buffer, cot, stash, grads, aux, dx_mb, aux_stage), None
 
     mb_shape = x_mb.shape[1:]
     buffer0 = _cb(
@@ -367,9 +413,13 @@ def one_f_one_b(
     )
     dx0 = _cx(jnp.zeros_like(x_mb))
 
-    (_, _, _, grads, aux, dx_mb), _ = jax.lax.scan(
-        tick, (buffer0, cot0, stash0, grads0, aux0, dx0), jnp.arange(steps)
+    (_, _, _, grads, aux, dx_mb, aux_stage), _ = jax.lax.scan(
+        tick,
+        (buffer0, cot0, stash0, grads0, aux0, dx0, jnp.float32(0.0)),
+        jnp.arange(steps),
     )
+    if has_aux:
+        return aux, grads, dx_mb, aux_stage
     return aux, grads, dx_mb
 
 
