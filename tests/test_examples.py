@@ -68,6 +68,13 @@ class TestExamplesRun:
         assert r.returncode == 0, r.stderr
         assert "accuracy" in r.stdout and "grad_norm" in r.stdout
 
+    @pytest.mark.slow
+    def test_pipeline_example_1f1b(self):
+        r = _run_example(os.path.join("by_feature", "pipeline.py"),
+                         "--schedule", "1f1b")
+        assert r.returncode == 0, r.stderr
+        assert "'final_loss'" in r.stdout
+
     def test_peak_memory_tracking_example(self):
         r = _run_example(os.path.join("by_feature", "peak_memory_tracking.py"))
         assert r.returncode == 0, r.stderr
