@@ -437,25 +437,21 @@ class TestHostOffload:
         losses = [float(jax.device_get(step(batch)["loss"])) for _ in range(3)]
         return accelerator, model, losses
 
-    def test_optimizer_state_offload_trains(self):
-        accelerator, model, losses = self._train(offload_optimizer_state=True)
+    def test_param_and_optimizer_offload_train(self):
+        """One engine with BOTH offloads on (their composition is the
+        ZeRO-offload deployment shape): trains, and both state trees
+        actually live in pinned host between steps."""
+        accelerator, model, losses = self._train(
+            offload_optimizer_state=True, offload_params_to_host=True
+        )
         assert losses[-1] < losses[0], losses
-        kinds = {
-            getattr(l.sharding, "memory_kind", None)
-            for l in jax.tree_util.tree_leaves(model._engine.opt_state)
-            if hasattr(l, "sharding") and getattr(l, "ndim", 0) >= 1
-        }
-        assert "pinned_host" in kinds, kinds
-
-    def test_param_offload_trains(self):
-        accelerator, model, losses = self._train(offload_params_to_host=True)
-        assert losses[-1] < losses[0], losses
-        kinds = {
-            getattr(l.sharding, "memory_kind", None)
-            for l in jax.tree_util.tree_leaves(model._engine.params)
-            if hasattr(l, "sharding") and getattr(l, "ndim", 0) >= 1
-        }
-        assert "pinned_host" in kinds, kinds
+        for tree in (model._engine.opt_state, model._engine.params):
+            kinds = {
+                getattr(l.sharding, "memory_kind", None)
+                for l in jax.tree_util.tree_leaves(tree)
+                if hasattr(l, "sharding") and getattr(l, "ndim", 0) >= 1
+            }
+            assert "pinned_host" in kinds, kinds
 
     def test_both_offloads_with_imperative_loop(self):
         from accelerate_tpu.state import AcceleratorState

@@ -84,6 +84,27 @@ class TestMoeParity:
         assert np.isfinite(float(aux))
 
 
+_MOE_KW = dict(num_layers=4, moe_num_experts=4, moe_capacity_factor=2.0)
+
+
+def _moe_pipeline_fixtures():
+    """dense + pipelined MoE models sharing remapped params (module-level so
+    the gpipe and 1f1b parity tests stay independently runnable)."""
+    from accelerate_tpu.parallel.pipeline import remap_params_to_pipeline
+    from accelerate_tpu.parallel.sharding import unbox_params
+
+    dense = DecoderLM(DecoderConfig.tiny(**_MOE_KW))
+    pipe = DecoderLM(
+        DecoderConfig.tiny(pipeline_stages=2, pipeline_microbatches=2, **_MOE_KW)
+    )
+    ids0 = jnp.zeros((4, 16), jnp.int32)
+    dense_p, _ = unbox_params(dense.init(jax.random.PRNGKey(0), ids0)["params"])
+    pipe_t, _ = unbox_params(pipe.init(jax.random.PRNGKey(0), ids0)["params"])
+    pipe_p = remap_params_to_pipeline(dense_p, pipe_t, 2)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 256)
+    return dense, pipe, dense_p, pipe_p, ids
+
+
 class TestMoeDecoder:
     def test_moe_lm_trains_and_reports_aux(self):
         cfg = DecoderConfig.tiny(num_layers=2, moe_num_experts=4, moe_top_k=2)
@@ -151,26 +172,15 @@ class TestMoeDecoder:
         loss = float(loss_fn(params, jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 256)))
         assert np.isfinite(loss)
 
-    def test_moe_pipeline_matches_dense(self):
-        """MoE through the pipeline: the GPipe belt carries the router aux
-        (loss AND aux_loss parity with the dense scan on remapped params),
-        and the 1F1B manual backward matches AD grads including the
-        router-balance term. Routing is deterministic, so parity is exact
-        up to f32 reduction order."""
-        from accelerate_tpu.parallel.pipeline import remap_params_to_pipeline
-        from accelerate_tpu.parallel.sharding import unbox_params
-
-        kw = dict(num_layers=4, moe_num_experts=4, moe_capacity_factor=2.0)
-        dense = DecoderLM(DecoderConfig.tiny(**kw))
+    def test_moe_gpipe_matches_dense(self):
+        """MoE through the GPipe pipeline: the belt carries the router aux —
+        loss AND aux_loss parity with the dense scan on remapped params.
+        Routing is deterministic, so parity is exact up to f32 reduction
+        order."""
+        dense, _, dense_p, pipe_p, ids = _moe_pipeline_fixtures()
         pipe = DecoderLM(
-            DecoderConfig.tiny(pipeline_stages=2, pipeline_microbatches=2, **kw)
+            DecoderConfig.tiny(pipeline_stages=2, pipeline_microbatches=2, **_MOE_KW)
         )
-        ids0 = jnp.zeros((4, 16), jnp.int32)
-        dense_p, _ = unbox_params(dense.init(jax.random.PRNGKey(0), ids0)["params"])
-        pipe_t, _ = unbox_params(pipe.init(jax.random.PRNGKey(0), ids0)["params"])
-        pipe_p = remap_params_to_pipeline(dense_p, pipe_t, 2)
-        ids = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 256)
-
         out_d = dense.apply({"params": dense_p}, ids, labels=ids)
         out_p = pipe.apply({"params": pipe_p}, ids, labels=ids)
         assert float(out_d["aux_loss"]) > 0
@@ -181,10 +191,17 @@ class TestMoeDecoder:
             float(out_d["loss"]), float(out_p["loss"]), rtol=2e-5
         )
 
+    @pytest.mark.slow
+    def test_moe_1f1b_matches_ad_grads(self):
+        """The 1F1B manual backward matches AD grads including the
+        router-balance term (stage_aux_weight cotangent seeding)."""
+        dense, _, dense_p, pipe_p, ids = _moe_pipeline_fixtures()
+        out_d = dense.apply({"params": dense_p}, ids, labels=ids)
+
         pipe1f = DecoderLM(
             DecoderConfig.tiny(
                 pipeline_stages=2, pipeline_microbatches=2,
-                pipeline_schedule="1f1b", **kw,
+                pipeline_schedule="1f1b", **_MOE_KW,
             )
         )
         vag = pipe1f.pipeline_value_and_grad()
