@@ -178,6 +178,27 @@ class PreparedModel:
         return m
 
 
+def _roll_fp8_stats(extra_state):
+    """Advance the delayed-fp8 amax histories one optimizer step (forwards
+    max-accumulate into the current slot; the engine rolls the slot HERE so
+    accumulation microsteps / pipeline ticks share one slot and the window
+    spans real steps — TE's per-iteration roll). No-op without a live
+    "fp8_stats" collection. Callers must NOT roll on paths that cannot
+    record amaxes (a user loss_fn cannot update mutable collections — its
+    forwards discard the writes, and rolling anyway would drain a restored
+    history to zeros within history_len steps)."""
+    from collections.abc import Mapping
+
+    if isinstance(extra_state, Mapping) and "fp8_stats" in extra_state:
+        from .ops.fp8 import roll_amax_histories
+
+        return {
+            **extra_state,
+            "fp8_stats": roll_amax_histories(extra_state["fp8_stats"]),
+        }
+    return extra_state
+
+
 def _make_scale_state(kwargs: GradScalerKwargs) -> dict:
     """Dynamic loss scale (GradScaler analog) as a device pytree."""
     return {
@@ -640,6 +661,7 @@ class TrainEngine:
             self._last_skipped = False
         self._accum_grads = None
         self._accum_finite = None
+        self.extra_state = _roll_fp8_stats(self.extra_state)
         self.step_count += 1
 
     def last_step_skipped(self) -> bool:
@@ -843,6 +865,11 @@ class TrainEngine:
                 params, opt_state, grads, scale_state, finite,
                 jnp.asarray(max_norm, jnp.float32) if max_norm is not None else None,
             )
+            if user_loss is None:
+                # the user-loss path cannot record amaxes (no handle to
+                # return mutated collections) — rolling there would drain
+                # the history; see _roll_fp8_stats
+                new_extra = _roll_fp8_stats(new_extra)
             metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
             return new_params, new_opt, new_extra, new_scale, skipped, metrics
 
@@ -1166,6 +1193,8 @@ class TrainEngine:
                 self._comp_state, rng_key, batch
             )
             self.params, self.opt_state = new_params, new_opt
+            if user_loss is None:
+                new_es = _roll_fp8_stats(new_es)
             self.extra_state = new_es
             self._comp_state = new_comp
             if self.scale_state is not None:

@@ -109,11 +109,20 @@ class DecoderConfig:
                 f"remat_policy must be 'save_attention', 'save_dots' or "
                 f"'full', got {self.remat_policy!r}"
             )
-        if self.fp8_recipe == "delayed" and self.pipeline_stages > 1:
+        if (
+            self.fp8_recipe == "delayed"
+            and self.pipeline_stages > 1
+            and self.pipeline_schedule == "1f1b"
+        ):
+            # gpipe carries the stage-stacked amax histories through the
+            # schedule scan (parallel/pipeline.PipelineStages
+            # variable_carry); the manual 1f1b backward cannot return
+            # mutated collections
             raise NotImplementedError(
-                "delayed fp8 scaling + pipeline parallelism is not wired "
-                "(per-tick amax-history writes through the stage belt have "
-                "no defined semantics); use fp8_recipe='current'"
+                "delayed fp8 scaling + the 1f1b schedule is not wired "
+                "(the manual backward cannot thread the amax-history "
+                "collection); use pipeline_schedule='gpipe' or "
+                "fp8_recipe='current'"
             )
         if self.pipeline_schedule not in ("gpipe", "1f1b"):
             raise ValueError(

@@ -390,10 +390,21 @@ class DecoderLM(nn.Module):
                 split_microbatches,
             )
 
-            if cfg.use_fp8 and cfg.fp8_recipe == "delayed":
+            if (
+                cfg.use_fp8
+                and cfg.fp8_recipe == "delayed"
+                and cfg.pipeline_schedule == "1f1b"
+            ):
+                # gpipe carries the amax histories through the schedule scan
+                # (PipelineStages variable_carry); the manual 1f1b backward
+                # cannot return mutated collections, so the engine would
+                # silently train a different schedule than configured —
+                # reject instead
                 raise NotImplementedError(
-                    "delayed fp8 scaling + pipeline parallelism is not "
-                    "wired; use fp8_recipe='current'"
+                    "delayed fp8 scaling + the 1f1b schedule is not wired "
+                    "(the manual backward cannot thread the amax-history "
+                    "collection); use pipeline_schedule='gpipe' or "
+                    "fp8_recipe='current'"
                 )
             if cfg.pipeline_stages <= 1:
                 cfg = dataclasses.replace(cfg, pipeline_stages=num_stages)

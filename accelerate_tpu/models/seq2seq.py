@@ -416,10 +416,16 @@ class _Decoder(nn.Module):
                     "the layer scan (parallel/pipeline.stages_to_stack_layers) "
                     "and generate without a stage axis"
                 )
-            if cfg.use_fp8 and cfg.fp8_recipe == "delayed":
+            if (
+                cfg.use_fp8
+                and cfg.fp8_recipe == "delayed"
+                and cfg.pipeline_schedule == "1f1b"
+            ):
                 raise NotImplementedError(
-                    "delayed fp8 scaling + pipeline parallelism is not "
-                    "wired; use fp8_recipe='current'"
+                    "delayed fp8 scaling + the 1f1b schedule is not wired "
+                    "(the manual backward cannot thread the amax-history "
+                    "collection); use pipeline_schedule='gpipe' or "
+                    "fp8_recipe='current'"
                 )
             import dataclasses as _dc
 

@@ -105,12 +105,38 @@ def _roll_in(hist_row, amax):
     return jnp.concatenate([amax[None], hist_row[:-1]])
 
 
+def _record_amax(hist_row, amax):
+    """Accumulate this call's amax into the CURRENT slot (element-wise max).
+    The slot ADVANCES once per optimizer step (`roll_amax_histories`, called
+    by the TrainEngine), not per contraction call — so pipeline schedule
+    ticks and gradient-accumulation microsteps share one history slot per
+    step and the window spans `fp8_amax_history_len` real steps (TE's
+    per-iteration roll), instead of shrinking by the microbatch factor."""
+    return hist_row.at[0].set(jnp.maximum(hist_row[0], amax))
+
+
+def roll_amax_histories(stats_tree):
+    """Advance every amax history one step: shift the slots, zero the new
+    current slot (a zero slot contributes nothing to the max-over-history
+    scale). Leaves are [..., 2, H]; works under layer-scan and
+    pipeline-stage leading dims alike. The TrainEngine calls this once per
+    optimizer step when an "fp8_stats" collection is live."""
+
+    def _one(leaf):
+        return jnp.concatenate(
+            [jnp.zeros_like(leaf[..., :1]), leaf[..., :-1]], axis=-1
+        )
+
+    return jax.tree_util.tree_map(_one, stats_tree)
+
+
 def fp8_dot_delayed(a: jax.Array, b: jax.Array, hist: jax.Array, margin: float = 1.0):
     """``a [..., K] @ b [K, N]`` under the DELAYED-scaling fp8 recipe
     (reference utils/transformer_engine.py:96-130 builds exactly this TE
     recipe): forward operands quantize with scales derived from the amax
-    HISTORY of previous steps, not the current tensor, and the history rolls
-    forward with this step's amaxes. Returns ``(out, new_hist)``.
+    HISTORY of previous steps, not the current tensor; this call's amaxes
+    max-accumulate into the history's current slot (the slot advances once
+    per optimizer step — `roll_amax_histories`). Returns ``(out, new_hist)``.
 
     Current scaling (``fp8_dot``) is usually the better default on TPU —
     XLA fuses the amax reduction into the producer, so the "extra pass"
@@ -123,8 +149,8 @@ def fp8_dot_delayed(a: jax.Array, b: jax.Array, hist: jax.Array, margin: float =
     sa = _delayed_scale(hist[0], E4M3_MAX, margin)
     sb = _delayed_scale(hist[1], E4M3_MAX, margin)
     new_hist = jnp.stack([
-        _roll_in(hist[0], jnp.max(jnp.abs(a.astype(jnp.float32)))),
-        _roll_in(hist[1], jnp.max(jnp.abs(b.astype(jnp.float32)))),
+        _record_amax(hist[0], jnp.max(jnp.abs(a.astype(jnp.float32)))),
+        _record_amax(hist[1], jnp.max(jnp.abs(b.astype(jnp.float32)))),
     ])
     out = _fp8_dot_with_scales(a, b, sa, sb)
     return out, new_hist

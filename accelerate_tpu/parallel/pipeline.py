@@ -91,12 +91,19 @@ class PipelineStages(nn.Module):
 
         # Stage-vmapped module: params [S, ...] with partition name "stage".
         # Per-microbatch consts arrive pre-gathered with a leading stage dim.
+        # fp8_stats (the delayed-recipe amax histories) also gain a stage
+        # dim; the time loop CARRIES them, and each tick MAX-ACCUMULATES
+        # its amaxes into the current history slot (ops/fp8._record_amax) —
+        # the slot advances once per optimizer step, engine-side, so the
+        # window spans real steps. Fill/drain ticks contribute amax 0: both
+        # pipelined model families are bias-free RMSNorm architectures, so
+        # a zero buffer stays exactly zero through every stage op.
         Stages = nn.vmap(
             self.stage_module,
             in_axes=(0,) + (None,) * len(bcast) + (0,) * n_mb,
             out_axes=0,
             axis_size=S,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "fp8_stats": 0},
             split_rngs={"params": True, "dropout": True},
             metadata_params={nn.PARTITION_NAME: "stage"},
         )
@@ -141,13 +148,6 @@ class PipelineStages(nn.Module):
                 buffer = outer._constrain_buffer(buffer)
                 return (buffer, outputs, aux_acc), None
 
-        TimeLoop = nn.scan(
-            _Step,
-            variable_broadcast="params",
-            split_rngs={"params": False, "dropout": True},
-            length=steps,
-        )
-
         mb_shape = x_microbatches.shape[1:]
         buffer0 = jnp.concatenate(
             [
@@ -158,9 +158,34 @@ class PipelineStages(nn.Module):
         )
         buffer0 = self._constrain_buffer(buffer0)
         outputs0 = self._constrain_outputs(jnp.zeros_like(x_microbatches))
-        (_, outputs, aux_total), _ = TimeLoop(name="schedule")(
-            (buffer0, outputs0, jnp.float32(0.0)), jnp.arange(steps)
-        )
+        carry0 = (buffer0, outputs0, jnp.float32(0.0))
+        if self.is_initializing():
+            # ONE direct tick instead of the scan: param paths and rng
+            # streams are identical (broadcast params, same "schedule"
+            # scope), and a CARRIED collection (fp8_stats amax histories)
+            # must exist before lax.scan can thread it — a collection first
+            # created inside the scan body changes the carry structure
+            # mid-scan, which jax rejects.
+            (_, outputs, aux_total), _ = _Step(name="schedule")(
+                carry0, jnp.asarray(0)
+            )
+        else:
+            # fp8 amax histories CARRY across ticks only when this apply may
+            # mutate them (training); eval applies pass the collection
+            # immutable — flax cannot thread an immutable collection through
+            # the carry, so it broadcasts instead (module_fp8_dot reads the
+            # history for scales and skips the write)
+            stats_mutable = self.is_mutable_collection("fp8_stats")
+            TimeLoop = nn.scan(
+                _Step,
+                variable_broadcast=("params",) + (() if stats_mutable else ("fp8_stats",)),
+                variable_carry="fp8_stats" if stats_mutable else (),
+                split_rngs={"params": False, "dropout": True},
+                length=steps,
+            )
+            (_, outputs, aux_total), _ = TimeLoop(name="schedule")(
+                carry0, jnp.arange(steps)
+            )
         if self.stage_returns_aux:
             return outputs, aux_total
         return outputs

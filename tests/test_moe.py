@@ -177,10 +177,7 @@ class TestMoeDecoder:
         loss AND aux_loss parity with the dense scan on remapped params.
         Routing is deterministic, so parity is exact up to f32 reduction
         order."""
-        dense, _, dense_p, pipe_p, ids = _moe_pipeline_fixtures()
-        pipe = DecoderLM(
-            DecoderConfig.tiny(pipeline_stages=2, pipeline_microbatches=2, **_MOE_KW)
-        )
+        dense, pipe, dense_p, pipe_p, ids = _moe_pipeline_fixtures()
         out_d = dense.apply({"params": dense_p}, ids, labels=ids)
         out_p = pipe.apply({"params": pipe_p}, ids, labels=ids)
         assert float(out_d["aux_loss"]) > 0

@@ -551,18 +551,6 @@ class TestFp8DelayedScaling:
         )
         assert rel_l2 < 0.3 and cos > 0.98, (rel_l2, cos)
 
-    def test_delayed_plus_pipeline_rejected(self):
-        """The unsupported combination must fail loudly AT CONFIG TIME
-        (round-4 VERDICT: wire or explicitly reject with a tested error)."""
-        import dataclasses
-
-        from accelerate_tpu.models import DecoderConfig
-
-        with pytest.raises(NotImplementedError, match="delayed fp8"):
-            dataclasses.replace(
-                DecoderConfig.tiny(num_layers=2), use_fp8=True,
-                fp8_recipe="delayed", pipeline_stages=2,
-            )
 
     def test_old_checkpoint_without_new_histories_still_loads(self, tmp_path):
         """Checkpoint forward-compat: a delayed-fp8 save from before the
@@ -643,3 +631,91 @@ class TestFp8DelayedScaling:
             DecoderLM(cfg_late).apply({"params": params}, ids)
         msgs = [str(x.message) for x in w]
         assert any("CURRENT scaling" in m for m in msgs), msgs
+
+
+class TestFp8DelayedPipeline:
+    """Delayed scaling through the GPipe pipeline: the amax histories gain a
+    stage dim (PipelineStages variable_axes) and CARRY across schedule ticks
+    (variable_carry), max-accumulating into the current slot; the slot
+    advances once per optimizer step (engine-side roll_amax_histories), so
+    the window spans real steps — TE's per-iteration roll. 1F1B + delayed
+    stays a tested rejection (the manual backward cannot return mutated
+    collections)."""
+
+    def test_decoder_delayed_gpipe_trains_and_rolls_history(self):
+        import dataclasses
+
+        import optax
+
+        from accelerate_tpu import Accelerator, Model
+        from accelerate_tpu.models import DecoderConfig, DecoderLM
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc = Accelerator(mixed_precision="fp8")
+        cfg = dataclasses.replace(
+            DecoderConfig.tiny(num_layers=4), use_fp8=True,
+            fp8_recipe="delayed", fp8_amax_history_len=4,
+            pipeline_stages=2, pipeline_microbatches=2,
+        )
+        model_def = DecoderLM(cfg, mesh=acc.mesh)
+        variables = model_def.init_variables(
+            jax.random.PRNGKey(0), batch_size=4, seq_len=16
+        )
+        assert "fp8_stats" in variables, list(variables)
+        # stats carry the stage dim in front: [S, L/S, ...]
+        lead = {
+            tuple(l.shape[:2]) for l in jax.tree_util.tree_leaves(
+                variables["fp8_stats"]
+            )
+        }
+        assert all(s[0] == 2 for s in lead), lead
+        model, opt = acc.prepare(Model(model_def, variables), optax.adam(1e-2))
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 16))
+        )
+        losses = []
+        for _ in range(2):
+            out = model(ids, labels=ids)
+            acc.backward(out["loss"])
+            opt.step()
+            opt.zero_grad()
+            losses.append(float(jax.device_get(out["loss"])))
+        assert all(np.isfinite(l) for l in losses), losses
+        stats = model._engine.extra_state["fp8_stats"]
+        hist_leaves = jax.tree_util.tree_leaves(stats)
+        assert any(float(jnp.max(h)) > 0 for h in hist_leaves)
+        # the slot advances once per OPTIMIZER step, not per schedule tick:
+        # after 2 steps at most 2 history slots are populated (a per-tick
+        # roll would have flushed the whole len-4 window every step)
+        for h in hist_leaves:
+            occupied = int(jnp.sum(jnp.any(h > 0, axis=tuple(range(h.ndim - 1)))))
+            assert occupied <= 2, (occupied, h.shape)
+        # eval forward must run (stats broadcast immutably through the scan)
+        model.eval()
+        logits = model(ids)["logits"]
+        assert np.all(np.isfinite(np.asarray(jax.device_get(logits[:, -1])))), "eval logits"
+
+    def test_delayed_1f1b_raises(self):
+        import dataclasses
+
+        from accelerate_tpu.models import DecoderConfig, DecoderLM
+
+        with pytest.raises(NotImplementedError, match="1f1b schedule"):
+            dataclasses.replace(
+                DecoderConfig.tiny(num_layers=4), use_fp8=True,
+                fp8_recipe="delayed", pipeline_stages=2,
+                pipeline_schedule="1f1b",
+            )
+        # mesh-auto-enabled pipelines bypass config validation; the model
+        # rejects at call time instead
+        cfg = dataclasses.replace(
+            DecoderConfig.tiny(num_layers=4), use_fp8=True, fp8_recipe="delayed",
+        )
+        from accelerate_tpu.parallel.mesh import build_mesh
+
+        with pytest.raises(NotImplementedError, match="1f1b|gpipe"):
+            DecoderLM(
+                dataclasses.replace(cfg, pipeline_schedule="1f1b"),
+                mesh=build_mesh({"stage": 2, "data": 4}),
+            ).init_variables(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
