@@ -48,6 +48,10 @@ def __getattr__(name):
         from .local_sgd import LocalSGD
 
         return LocalSGD
+    if name in ("TelemetryConfig", "TelemetrySession"):
+        from . import telemetry
+
+        return getattr(telemetry, name)
     if name in ("skip_first_batches", "prepare_data_loader", "DataLoader"):
         from . import data
 

@@ -35,6 +35,7 @@ import contextlib
 import functools
 import math
 import os
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional, Union
 
@@ -270,6 +271,10 @@ class TrainEngine:
         self.loss_fn = model.loss_fn or _default_loss_selector
         self._jit_cache: dict = {}
         self.donate_state = accelerator.compile_plugin.donate_state
+        # telemetry session (set by Accelerator.prepare when enabled); the
+        # step paths guard on `is not None` so disabled runs pay one check
+        self.telemetry = None
+        self._pipeline_fallback_warned = False
         # models can own their backward schedule (DecoderLM 1f1b pipeline:
         # interleaved per-microbatch fwd/bwd that reverse-mode AD cannot
         # express). Only usable when the loss comes from the model itself —
@@ -315,10 +320,24 @@ class TrainEngine:
                     getattr(getattr(model.definition, "config", None), "dropout_rate", 0) > 0
                 )
                 if wants:
+                    hook_takes_rng = False
                     try:
-                        wants = "rng" in inspect.signature(self._manual_vag).parameters
+                        hook_takes_rng = "rng" in inspect.signature(self._manual_vag).parameters
                     except (TypeError, ValueError):
-                        wants = False
+                        pass
+                    if not hook_takes_rng:
+                        # the AD path would train WITH dropout for this
+                        # config, so an rng-less duck-typed hook silently
+                        # toggles regularization per-batch-routing (ADVICE r5)
+                        logger.warning(
+                            "model config has dropout_rate > 0 but its "
+                            "pipeline_value_and_grad hook accepts no 'rng' "
+                            "parameter: batches routed through the manual "
+                            "pipeline schedule will train WITHOUT dropout. "
+                            "Add an `rng=` kwarg to the hook to receive the "
+                            "per-step dropout key."
+                        )
+                    wants = hook_takes_rng
                 self._manual_vag_wants_rng = wants
 
     # ------------------------------------------------------------------
@@ -356,12 +375,49 @@ class TrainEngine:
 
     def _cast_params(self, params):
         if self.sharding_config.offload_params_to_host:
-            from .parallel.sharding import transfer_tree
+            from .parallel.sharding import device_memory_space, transfer_tree
 
-            params = transfer_tree(params, jax.memory.Space.Device)
+            params = transfer_tree(params, device_memory_space())
         c = self.precision.compute_dtype
         return jax.tree_util.tree_map(
             lambda p: p.astype(c) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
+        )
+
+    def _warn_pipeline_fallback(self, args, kwargs, reason: str = None):
+        """One-time notice that a 1F1B-capable model is training through the
+        AD/GPipe fallback: gradients are equivalent, but the O(M) activation
+        stash silently replaces the configured O(S) schedule's memory
+        profile — a model sized for 1F1B can OOM the moment a batch key
+        forces this path (ADVICE r5). Names the offending key(s)."""
+        if self._pipeline_fallback_warned:
+            return
+        self._pipeline_fallback_warned = True
+        if reason is None:
+            named = {}
+            extra_positional = 0
+            for i, a in enumerate(args):
+                if i < len(self._call_argnames):
+                    named[self._call_argnames[i]] = a
+                else:
+                    extra_positional += 1
+            named.update(kwargs)
+            offending = sorted(k for k in named if k not in ("input_ids", "labels"))
+            if extra_positional:
+                offending.append(f"{extra_positional} extra positional arg(s)")
+            if offending:
+                reason = f"batch key(s) {', '.join(offending)} forced the fallback"
+            elif "labels" not in named:
+                reason = "the batch carries no labels"
+            else:
+                reason = "the batch does not match the (input_ids, labels) signature"
+        logger.warning(
+            "model exposes pipeline_value_and_grad (1f1b schedule) but this "
+            "training step runs through the AD/GPipe fallback: %s. The "
+            "fallback computes identical gradients but stashes activations "
+            "for ALL microbatches (O(M) memory instead of the schedule's "
+            "O(S)) — a model sized for 1F1B can OOM here. Feed plain "
+            "(input_ids, labels) batches to use the configured schedule.",
+            reason,
         )
 
     # ------------------------------------------------------------------
@@ -408,6 +464,13 @@ class TrainEngine:
                     grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
                     finite = jnp.asarray(True)
                 return outputs, extra_state, grads, finite, loss
+
+        if self._manual_vag is not None:
+            self._warn_pipeline_fallback(
+                args, kwargs,
+                reason="live mutable collections cannot thread through the "
+                       "manual backward" if extra_state else None,
+            )
 
         def loss_of(p):
             outputs, new_state = self._apply(
@@ -457,6 +520,8 @@ class TrainEngine:
 
         rng_key = default_keychain().next_key("dropout")
         scale = self.scale_state["scale"] if self.scale_state is not None else None
+        if self.telemetry is not None:
+            self.telemetry.note_batch(args, kwargs, self._call_argnames)
 
         fwd_bwd = self._get_jit(
             "fwd_bwd",
@@ -510,6 +575,7 @@ class TrainEngine:
 
     def attach_optimizer(self, optimizer: optax.GradientTransformation, schedule=None):
         from .parallel.sharding import (
+            device_memory_space,
             infer_opt_state_sharding,
             transfer_tree,
             tree_with_memory_kind,
@@ -528,9 +594,10 @@ class TrainEngine:
         self.opt_state_sharding = infer_opt_state_sharding(
             optimizer, self.params, base_param_sharding, self.mesh
         )
+        device_space = device_memory_space()
         init = self._get_jit(
             "opt_init",
-            lambda p: optimizer.init(transfer_tree(p, jax.memory.Space.Device)),
+            lambda p: optimizer.init(transfer_tree(p, device_space)),
             out_shardings=self.opt_state_sharding,
         )
         self.opt_state = init(self.params)
@@ -567,14 +634,14 @@ class TrainEngine:
     def _update_fn(self, params, opt_state, grads, scale_state, finite, max_norm):
         """One optimizer update: clip -> optax -> apply; fp16 skip via cond.
         Host-offloaded state streams HBM-ward here and back at the end."""
-        from .parallel.sharding import transfer_tree
+        from .parallel.sharding import device_memory_space, transfer_tree
 
         offload_opt = self.sharding_config.offload_optimizer_state
         offload_p = self.sharding_config.offload_params_to_host
         if offload_opt:
-            opt_state = transfer_tree(opt_state, jax.memory.Space.Device)
+            opt_state = transfer_tree(opt_state, device_memory_space())
         if offload_p:
-            params = transfer_tree(params, jax.memory.Space.Device)
+            params = transfer_tree(params, device_memory_space())
         if max_norm is not None:
             gnorm = optax.global_norm(grads)
             clip_scale = jnp.minimum(1.0, max_norm / (gnorm + 1e-6))
@@ -663,6 +730,8 @@ class TrainEngine:
         self._accum_finite = None
         self.extra_state = _roll_fp8_stats(self.extra_state)
         self.step_count += 1
+        if self.telemetry is not None:
+            self.telemetry.on_optimizer_step(self)
 
     def last_step_skipped(self) -> bool:
         if isinstance(self._last_skipped, bool):
@@ -815,6 +884,13 @@ class TrainEngine:
 
                 args, kwargs = _batch_to_call(mb)
                 ids, labels = _extract_lm_batch(args, kwargs, self._call_argnames)
+                if manual_vag is not None and (es or labels is None):
+                    # trace-time notice (the routing is static per compile)
+                    self._warn_pipeline_fallback(
+                        args, kwargs,
+                        reason="live mutable collections cannot thread "
+                               "through the manual backward" if es else None,
+                    )
                 if manual_vag is not None and not es and labels is not None:
                     # model-owned backward schedule (1f1b pipeline): the loss
                     # scale seeds the manual backward's cotangent, so the
@@ -898,6 +974,8 @@ class TrainEngine:
         jitted = jax.jit(fused_fn, donate_argnums=(0, 1) if self.donate_state else ())
 
         def run(batch):
+            tm = self.telemetry
+            t0 = time.perf_counter() if tm is not None else None
             rng_key = default_keychain().next_key("train_step")
             new_params, new_opt, new_extra, new_scale, skipped, metrics = jitted(
                 self.params, self.opt_state, self.extra_state, self.scale_state, rng_key, batch
@@ -912,6 +990,16 @@ class TrainEngine:
                 self.scale_state = new_scale
                 self._last_skipped = skipped
             self.step_count += steps_per_call if steps_per_call else 1
+            if tm is not None:
+                from .telemetry.metrics import batch_token_count
+
+                tokens, samples, seq_len = batch_token_count(batch)
+                tm.on_step(
+                    self, time.perf_counter() - t0, tokens=tokens,
+                    samples=samples, seq_len=seq_len,
+                    steps=steps_per_call if steps_per_call else 1,
+                    metrics=metrics,
+                )
             return metrics
 
         return run
@@ -946,9 +1034,9 @@ class TrainEngine:
         fp16 loss scaling composes: the backward runs scaled, grads unscale
         before compression, and the finite check gates the update exactly
         like the GSPMD path."""
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
+        from .parallel.sharding import shard_map_compat as shard_map
         from .utils.serialization import flatten_pytree, unflatten_to_like
 
         mesh = self.mesh
@@ -1187,6 +1275,8 @@ class TrainEngine:
         self._comp_state = comp_state
 
         def run(batch):
+            tm = self.telemetry
+            t0 = time.perf_counter() if tm is not None else None
             rng_key = default_keychain().next_key("train_step")
             new_params, new_opt, new_es, new_scale, new_comp, skipped, metrics = jitted(
                 self.params, self.opt_state, self.extra_state, self.scale_state,
@@ -1201,6 +1291,14 @@ class TrainEngine:
                 self.scale_state = new_scale
                 self._last_skipped = skipped
             self.step_count += 1
+            if tm is not None:
+                from .telemetry.metrics import batch_token_count
+
+                tokens, samples, seq_len = batch_token_count(batch)
+                tm.on_step(
+                    self, time.perf_counter() - t0, tokens=tokens,
+                    samples=samples, seq_len=seq_len, metrics=metrics,
+                )
             return metrics
 
         return run
@@ -1436,6 +1534,7 @@ class Accelerator:
         kwargs_handlers: Optional[list] = None,
         rng_types: Optional[list] = None,
         loss_fn: Optional[Callable] = None,
+        telemetry=None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -1496,6 +1595,15 @@ class Accelerator:
 
         self.log_with = filter_trackers(log_with, self.logging_dir)
         self.trackers: list = []
+
+        # runtime telemetry (docs/telemetry.md): `telemetry=` takes a
+        # TelemetryConfig (or True for defaults); None defers to the
+        # ATT_TELEMETRY env gate. Disabled -> self.telemetry is None and the
+        # engine step paths stay on their zero-overhead fast path.
+        from .telemetry import TelemetrySession, resolve_config
+
+        tcfg = resolve_config(telemetry)
+        self.telemetry = TelemetrySession(tcfg, accelerator=self) if tcfg else None
 
     # ------------------------------------------------------------------
     # state passthroughs (reference accelerator.py properties)
@@ -1647,6 +1755,8 @@ class Accelerator:
             model.definition = _enable_fp8(model.definition)
         engine = TrainEngine(model, self)
         self._engines.append(engine)
+        if self.telemetry is not None:
+            self.telemetry.attach_engine(engine)
         prepared = PreparedModel(engine)
         if evaluation_mode:
             prepared.eval()
@@ -1880,7 +1990,29 @@ class Accelerator:
         for tracker in self.trackers:
             tracker.log(values, step=step, **log_kwargs.get(tracker.name, {}))
 
+    def log_system_metrics(self, step: Optional[int] = None, extra: Optional[dict] = None,
+                           log_kwargs: dict = {}) -> dict:
+        """Flush the telemetry rollup (step time, tokens/s, MFU, data-wait
+        split, compile/cache activity, memory, precision health — see
+        docs/telemetry.md for the glossary) through every configured
+        tracker, and return it. Requires ``telemetry=`` to be enabled."""
+        if self.telemetry is None:
+            raise RuntimeError(
+                "telemetry is not enabled; pass telemetry=TelemetryConfig(...) "
+                "(or True) to Accelerator, or set ATT_TELEMETRY=1."
+            )
+        values = self.telemetry.rollup()
+        if extra:
+            values = {**values, **extra}
+        if values:
+            if step is None:
+                step = values.get("sys/step")
+            self.log(values, step=step, log_kwargs=log_kwargs)
+        return values
+
     def end_training(self):
+        if self.telemetry is not None:
+            self.telemetry.close()
         for tracker in self.trackers:
             tracker.finish()
 
@@ -2011,6 +2143,8 @@ class Accelerator:
         from .utils.memory import release_memory
 
         objects = release_memory(*objects)
+        if self.telemetry is not None:
+            self.telemetry._engines.clear()
         self._engines.clear()
         self._models.clear()
         self._optimizers.clear()

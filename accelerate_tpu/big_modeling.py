@@ -131,7 +131,15 @@ class DispatchedModel:
         else:
             from jax.sharding import SingleDeviceSharding
 
-            sharding = SingleDeviceSharding(jax.devices()[0], memory_kind="device")
+            from .parallel.sharding import _memory_kind_available
+
+            dev = jax.devices()[0]
+            # some backends (older-jax CPU) expose no "device" memory kind;
+            # the default placement is then the device memory anyway
+            if _memory_kind_available("device"):
+                sharding = SingleDeviceSharding(dev, memory_kind="device")
+            else:
+                sharding = SingleDeviceSharding(dev)
             device_shardings = {k: sharding for k in flat}
         streamable = []
         fn = getattr(self.definition, "host_streamable_prefixes", None)
@@ -375,11 +383,17 @@ class DispatchedModel:
         shardings = self._target_shardings()
         stream = self._STREAM
 
+        from .parallel.sharding import device_memory_space
+
+        device_space = device_memory_space()
+
         def _place(leaf, sh):
             if isinstance(sh, str):
                 if sh == stream:
                     return leaf
-                return jax.device_put(leaf, jax.memory.Space.Device)
+                if device_space is None:
+                    return jax.device_put(leaf, jax.local_devices()[0])
+                return jax.device_put(leaf, device_space)
             return jax.device_put(leaf, sh)
 
         def placer(p):

@@ -23,6 +23,7 @@ TPU-native differences:
 from __future__ import annotations
 
 import math
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import jax
@@ -40,8 +41,19 @@ from .utils.operations import (
     recursively_apply,
 )
 from .utils.random import default_keychain, synchronize_rng_states
+from .telemetry import note_data_wait
 
 logger = get_logger(__name__)
+
+
+def _timed_next(iterator):
+    """Advance the base iterator, attributing the host wait to telemetry's
+    dataloader-wait bucket (a no-op check when no session is active)."""
+    t0 = time.perf_counter()
+    try:
+        return next(iterator)
+    finally:
+        note_data_wait(time.perf_counter() - t0)
 
 
 # ---------------------------------------------------------------------------
@@ -451,14 +463,14 @@ class DataLoaderShard(BaseDataLoader):
             # one-batch-ahead prefetch to flag end_of_dataloader on the LAST
             # yield (reference :555-578)
             try:
-                current = next(iterator)
+                current = _timed_next(iterator)
             except StopIteration:
                 self.end_of_dataloader = True
                 return
             batch_index = 0
             while True:
                 try:
-                    upcoming = next(iterator)
+                    upcoming = _timed_next(iterator)
                     at_end = False
                 except StopIteration:
                     upcoming = None
@@ -471,7 +483,12 @@ class DataLoaderShard(BaseDataLoader):
                             or self.gradient_state.sync_with_dataloader
                         )
                     self._batches_yielded += 1
-                    yield self._finalize_batch(current, per_proc)
+                    # conversion + padding + device placement are loader work
+                    # too — time them into the same dataloader-wait bucket
+                    t0 = time.perf_counter()
+                    ready = self._finalize_batch(current, per_proc)
+                    note_data_wait(time.perf_counter() - t0)
+                    yield ready
                 if at_end:
                     return
                 current = upcoming
@@ -535,12 +552,16 @@ class DataLoaderDispatcher(BaseDataLoader):
             iterator = iter(self.base_loader) if state.is_main_process else None
             batch_index = 0
             stop = False
+            t0 = time.perf_counter()
             current = self._fetch_and_share(iterator, state)
+            note_data_wait(time.perf_counter() - t0)
             if current is None:
                 self.end_of_dataloader = True
                 return
             while True:
+                t0 = time.perf_counter()
                 upcoming = self._fetch_and_share(iterator, state)
+                note_data_wait(time.perf_counter() - t0)
                 at_end = upcoming is None
                 if batch_index >= skip:
                     if at_end:

@@ -112,9 +112,12 @@ def _stream_params_to_device(tree):
     this runs on the per-layer *slice*, so only the live layer's weights
     occupy HBM (the per-layer-streaming capability of reference
     hooks.py:323-390); on already-device-resident params it is a no-op."""
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, jax.memory.Space.Device), tree
-    )
+    from ..parallel.sharding import device_memory_space
+
+    space = device_memory_space()
+    if space is None:  # jax without memory spaces: nothing can be host-pinned
+        return tree
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, space), tree)
 
 
 def _maybe_streaming(body, cfg):

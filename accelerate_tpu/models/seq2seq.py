@@ -603,7 +603,10 @@ class Seq2SeqLM(nn.Module):
         decoder positions — no shift). Returns None when the schedule is
         not "1f1b"; the engine only routes plain (input_ids, labels)
         batches here, so the encoder padding mask is always None — masked
-        batches train through the AD/GPipe path instead."""
+        batches train through the AD/GPipe path instead (the engine warns
+        once, naming the batch key that forced the fallback, because the
+        O(M) GPipe stash silently replaces this schedule's O(S) memory
+        profile — TrainEngine._warn_pipeline_fallback)."""
         cfg = self.config
         mesh = self.mesh
         num_stages = _effective_stages(cfg, mesh)

@@ -70,7 +70,10 @@ class AcceleratedOptimizer:
                 "This AcceleratedOptimizer is not attached to a model; pass the "
                 "model and optimizer to `accelerator.prepare` together."
             )
-        self.engine.optimizer_step()
+        from .telemetry.spans import span
+
+        with span("engine/optimizer_step", cat="engine"):
+            self.engine.optimizer_step()
 
     def train(self):  # torch-parity no-op
         return self

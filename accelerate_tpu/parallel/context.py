@@ -25,7 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .sharding import shard_map_compat as shard_map
 
 from ..ops.attention import NEG_INF
 

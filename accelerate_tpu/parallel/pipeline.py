@@ -88,6 +88,17 @@ class PipelineStages(nn.Module):
 
         n_mb = self.num_mb_consts
         bcast, mb_consts = (consts, ()) if n_mb == 0 else (consts[:-n_mb], consts[-n_mb:])
+        for i, c in enumerate(mb_consts):
+            # the per-tick gather clamp-indexes dim 0, so a const that is
+            # not [M, ...] (e.g. an unsplit [B, T] mask) would silently
+            # select wrong rows instead of erroring — reject it here
+            if c.shape[0] != M:
+                raise ValueError(
+                    f"per-microbatch const {i} (trailing position "
+                    f"{i - n_mb}) has leading dim {c.shape[0]} but "
+                    f"num_microbatches={M}; split it with "
+                    f"split_microbatches(x, {M}) before the schedule"
+                )
 
         # Stage-vmapped module: params [S, ...] with partition name "stage".
         # Per-microbatch consts arrive pre-gathered with a leading stage dim.

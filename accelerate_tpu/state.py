@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from contextlib import contextmanager
 from functools import wraps
 from typing import Any, Callable, Optional
@@ -271,6 +272,25 @@ class PartialState:
             return result
 
         yield _split(inputs)
+
+    # -- telemetry heartbeat ----------------------------------------------
+
+    def publish_heartbeat(self, step: int):
+        """Record this process's training progress in the shared state dict.
+
+        The slot lives in ``_shared_state`` (the dict every PartialState
+        instance aliases), so the telemetry watchdog's monitor thread — or
+        any other observer — reads the latest beat through a fresh
+        ``PartialState()`` with zero coupling to the training loop. The
+        step counter is monotonic per run; the timestamp is
+        ``time.monotonic()`` (immune to wall-clock jumps)."""
+        self.__dict__["telemetry_heartbeat"] = (int(step), time.monotonic())
+
+    @property
+    def heartbeat(self):
+        """``(step, monotonic_time)`` of the last published heartbeat, or
+        None when nothing has beaten yet."""
+        return self.__dict__.get("telemetry_heartbeat")
 
     def set_device(self):  # pragma: no cover - parity no-op
         """JAX owns device selection; kept for API parity."""

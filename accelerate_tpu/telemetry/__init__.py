@@ -1,0 +1,455 @@
+"""Unified runtime telemetry: per-step metrics, span tracing, and the
+heartbeat/straggler watchdog.
+
+The reference Accelerate exposes observability as disconnected pieces
+(trackers, a profiler wrapper, prints). Here one session object ties the
+runtime together and the engine feeds it automatically:
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.telemetry import TelemetryConfig
+
+    accelerator = Accelerator(
+        log_with="jsonl", project_dir="runs/exp",
+        telemetry=TelemetryConfig(watchdog=True, watchdog_deadline_s=600),
+    )
+    ...
+    accelerator.log_system_metrics(step=step)   # rollup -> every tracker
+
+- **metrics pipeline** — every optimizer step (eager or fused
+  ``build_train_step``) records wall time, tokens, data-loader wait, and
+  XLA compile activity into a rolling window; ``rollup()`` adds MFU
+  (flops accounting shared with bench.py via ``telemetry.metrics``),
+  grad-norm/loss, fp16 loss-scale, fp8 amax health, device memory and the
+  PowerSGD wire-bytes estimate. Flushes ride the existing
+  ``GeneralTracker`` plumbing, so JSONL/TensorBoard/W&B get system
+  metrics for free (main-process gating included).
+- **span tracing** — ``telemetry.spans`` streams nestable spans as a
+  Chrome-trace-compatible JSONL per host (``utils/phases.py`` now rides
+  the same rails for the TTFT path).
+- **watchdog** — ``telemetry.watchdog`` monitors a shared-dict heartbeat
+  and dumps per-host stacks + the last spans when a step stalls.
+
+Everything is off unless a config is passed (or ``ATT_TELEMETRY=1``);
+when off, the engine's only cost is one ``is None`` check per step.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from .metrics import MetricsWindow, batch_token_count, flops_per_token_fn
+from .spans import SpanRecorder, load_chrome_trace, span  # noqa: F401 (public API)
+from .watchdog import HeartbeatWatchdog, build_stall_report  # noqa: F401
+
+_ACTIVE_SESSION: Optional["TelemetrySession"] = None
+
+
+def current_session() -> Optional["TelemetrySession"]:
+    return _ACTIVE_SESSION
+
+
+def note_data_wait(seconds: float):
+    """Hook for data loaders: attribute host time spent producing/placing a
+    batch to the *next* step's record. Near-free when telemetry is off."""
+    s = _ACTIVE_SESSION
+    if s is not None:
+        s.note_data_wait(seconds)
+
+
+@dataclass
+class TelemetryConfig:
+    """Knobs for the runtime telemetry session (see docs/telemetry.md).
+
+    ``trace_dir`` is where per-host artifacts land (span JSONL, watchdog
+    dumps, optional per-step metrics JSONL). When None it falls back to
+    ``<logging_dir>/telemetry`` if the Accelerator has a project dir,
+    else file-producing features quietly stay off (the metrics window and
+    watchdog still run).
+    """
+
+    enabled: bool = True
+    window: int = 32                       # rolling window, in step records
+    flush_every: int = 0                   # auto-flush to trackers every N steps (0 = manual)
+    trace_dir: Optional[str] = None
+    spans: bool = True                     # stream engine/user spans to JSONL
+    span_ring: int = 64                    # in-memory closed-span ring (watchdog dump)
+    annotate_device: bool = False          # bridge spans into jax.profiler timeline
+    metrics_jsonl: bool = False            # per-step records to metrics-host<i>.jsonl
+    metrics_path: Optional[str] = None     # exact per-step JSONL path (overrides)
+    device_memory: bool = True
+    flops_per_token: Optional[float] = None  # override the model-derived accounting
+    watchdog: bool = False
+    watchdog_deadline_s: float = 300.0
+    watchdog_poll_s: Optional[float] = None
+    heartbeat_dir: Optional[str] = None    # shared dir for cross-host straggler naming
+
+    @classmethod
+    def from_env(cls) -> Optional["TelemetryConfig"]:
+        """ATT_TELEMETRY=1 enables defaults; ATT_TELEMETRY_DIR sets
+        trace_dir; ATT_TELEMETRY_WATCHDOG_S enables the watchdog with that
+        deadline. Returns None when the env asks for nothing."""
+        flag = os.environ.get("ATT_TELEMETRY", "").strip().lower()
+        wd = os.environ.get("ATT_TELEMETRY_WATCHDOG_S", "").strip()
+        if flag in ("", "0", "false") and not wd:
+            return None
+        cfg = cls()
+        d = os.environ.get("ATT_TELEMETRY_DIR", "").strip()
+        if d:
+            cfg.trace_dir = d
+        if wd:
+            cfg.watchdog = True
+            cfg.watchdog_deadline_s = float(wd)
+        return cfg
+
+
+def resolve_config(telemetry) -> Optional[TelemetryConfig]:
+    """Accelerator-arg resolution: None -> env, True -> defaults, config
+    passthrough (honoring .enabled), anything falsy -> off."""
+    if telemetry is None:
+        return TelemetryConfig.from_env()
+    if telemetry is True:
+        return TelemetryConfig()
+    if isinstance(telemetry, TelemetryConfig):
+        return telemetry if telemetry.enabled else None
+    if not telemetry:
+        return None
+    raise TypeError(
+        f"telemetry= expects a TelemetryConfig, True/False or None; got {telemetry!r}"
+    )
+
+
+class TelemetrySession:
+    """One live telemetry pipeline: engines feed it, trackers drain it.
+
+    Created by the Accelerator (``telemetry=`` / ``ATT_TELEMETRY``) and
+    installed as the process-global session so decoupled producers (data
+    loaders, ``note_data_wait``) reach it without plumbing.
+    """
+
+    def __init__(self, config: TelemetryConfig, accelerator=None):
+        global _ACTIVE_SESSION
+        if _ACTIVE_SESSION is not None:
+            # a replaced session must not leak its watchdog thread / fds
+            _ACTIVE_SESSION.close()
+        self.config = config
+        self._accelerator = accelerator
+        self.process_index = self._process_index()
+        self.trace_dir = self._resolve_trace_dir()
+        self.window = MetricsWindow(config.window)
+        self._engines: list = []
+        self._data_wait = 0.0
+        self._pend_tokens = 0
+        self._pend_samples = 0
+        self._pend_seq_len = None
+        self._last_opt_t: Optional[float] = None
+        self._last_hb_file_t = 0.0
+        self._flops_fn = None
+        self._wire_bytes: Optional[int] = None
+        self._peak: Optional[float] = None
+        self._closed = False
+
+        self.recorder: Optional[SpanRecorder] = None
+        if config.spans and self.trace_dir:
+            from . import spans as _spans
+
+            self.recorder = _spans.arm(
+                os.path.join(self.trace_dir, f"trace-host{self.process_index}.jsonl"),
+                self.process_index, ring=config.span_ring,
+                annotate_device=config.annotate_device,
+            )
+
+        self._metrics_fh = None
+        path = config.metrics_path
+        if path is None and config.metrics_jsonl and self.trace_dir:
+            path = os.path.join(
+                self.trace_dir, f"metrics-host{self.process_index}.jsonl"
+            )
+        if path:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._metrics_fh = open(path, "a")
+
+        from ..utils.compile_cache import compile_event_counters, install_compile_listeners
+
+        install_compile_listeners()
+        self._compile_mark = compile_event_counters()
+
+        self.watchdog: Optional[HeartbeatWatchdog] = None
+        if config.watchdog:
+            self.watchdog = HeartbeatWatchdog(
+                deadline_s=config.watchdog_deadline_s,
+                poll_s=config.watchdog_poll_s,
+                heartbeat_dir=config.heartbeat_dir,
+                dump_dir=self.trace_dir,
+                last_spans=config.span_ring,
+            ).start()
+
+        _ACTIVE_SESSION = self
+
+    # -- setup helpers -----------------------------------------------------
+
+    @staticmethod
+    def _process_index() -> int:
+        from ..state import PartialState
+
+        return int(PartialState._shared_state.get("process_index", 0))
+
+    def _resolve_trace_dir(self) -> Optional[str]:
+        d = self.config.trace_dir
+        if d is None and self._accelerator is not None:
+            logging_dir = getattr(self._accelerator, "logging_dir", None)
+            if logging_dir:
+                d = os.path.join(str(logging_dir), "telemetry")
+        if d:
+            os.makedirs(d, exist_ok=True)
+        return d
+
+    def attach_engine(self, engine):
+        """Wire a TrainEngine: step hooks + the static accounting (FLOPs/token
+        from the model config, PowerSGD/dtype wire bytes from the sharding
+        config) that a rollup reports without touching the device."""
+        engine.telemetry = self
+        self._engines.append(engine)
+        if self.config.flops_per_token:
+            fpt = float(self.config.flops_per_token)
+            self._flops_fn = lambda seq_len: fpt
+        elif self._flops_fn is None:
+            cfg = getattr(engine.model.definition, "config", None)
+            if cfg is not None:
+                self._flops_fn = flops_per_token_fn(cfg)
+        sc = engine.sharding_config
+        if (
+            (getattr(sc, "grad_compression_dtype", None)
+             or getattr(sc, "grad_compression_rank", None))
+            and engine.mesh is not None
+            and engine.mesh.shape.get("replica", 1) > 1
+        ):
+            try:
+                self._wire_bytes = int(engine.replica_wire_bytes(
+                    engine.params,
+                    getattr(sc, "grad_compression_dtype", None),
+                    getattr(sc, "grad_compression_rank", None),
+                )["bytes"])
+            except Exception:
+                self._wire_bytes = None
+
+    # -- producers ---------------------------------------------------------
+
+    def note_data_wait(self, seconds: float):
+        self._data_wait += float(seconds)
+
+    def note_batch(self, args, kwargs, argnames: tuple = ()):
+        """Eager path: count the tokens of one model call (micro-steps
+        accumulate until the optimizer-step boundary drains them).
+        ``argnames`` is the model's positional parameter order, so
+        ``model(input_ids, labels)`` counts the same as the kwargs form."""
+        named = {argnames[i]: a for i, a in enumerate(args) if i < len(argnames)}
+        named.update(kwargs)
+        batch = named if named else (args[0] if len(args) == 1 else args)
+        tokens, samples, seq_len = batch_token_count(batch)
+        if tokens:
+            self._pend_tokens += tokens
+        if samples:
+            self._pend_samples += samples
+        if seq_len:
+            self._pend_seq_len = seq_len
+
+    def on_optimizer_step(self, engine):
+        """Eager-loop boundary: wall time = time since the previous boundary
+        (covers data + forward + update — the throughput-relevant number).
+        The first boundary only starts the clock."""
+        now = time.perf_counter()
+        wall = None if self._last_opt_t is None else now - self._last_opt_t
+        self._last_opt_t = now
+        tokens, self._pend_tokens = self._pend_tokens, 0
+        samples, self._pend_samples = self._pend_samples, 0
+        seq_len, self._pend_seq_len = self._pend_seq_len, None
+        if wall is None:
+            self._heartbeat(engine.step_count)
+            return
+        loss = engine._pending_loss
+        self.on_step(engine, wall, tokens=tokens or None, samples=samples or None,
+                     seq_len=seq_len, metrics={"loss": loss} if loss is not None else None)
+
+    def on_step(self, engine, wall_s: float, tokens=None, samples=None,
+                seq_len=None, steps: int = 1, metrics: Optional[dict] = None):
+        """Record one completed step (or one fused K-step dispatch)."""
+        step = engine.step_count
+        data_wait, self._data_wait = self._data_wait, 0.0
+        comp = self._drain_compile()
+        rec = {
+            "step": step,
+            "wall_s": float(wall_s),
+            "steps": int(steps),
+            "data_wait_s": data_wait,
+            "tokens": tokens,
+            "samples": samples,
+            "seq_len": seq_len,
+            **comp,
+        }
+        if tokens and seq_len and self._flops_fn is not None:
+            rec["flops"] = tokens * self._flops_fn(seq_len)
+        if metrics:
+            # device scalars stay lazy until a flush resolves them — a
+            # device_get here would serialize the async dispatch pipeline
+            rec["_loss"] = metrics.get("loss")
+            rec["_grad_norm"] = metrics.get("grad_norm")
+        self.window.add(rec)
+        self._heartbeat(step)
+        if self.recorder is not None:
+            self.recorder.emit("engine/train_step",
+                               time.perf_counter() - wall_s, wall_s,
+                               cat="engine", args={"step": step, "steps": steps})
+        if self._metrics_fh is not None:
+            self._write_step_record(rec)
+        fe = self.config.flush_every
+        if fe and len(self.window.records) and self.window.total_steps % fe == 0:
+            self.flush(step=step)
+
+    def _heartbeat(self, step: int):
+        from ..state import PartialState
+
+        if PartialState._shared_state:
+            PartialState().publish_heartbeat(step)
+        if self.config.heartbeat_dir:
+            now = time.monotonic()
+            if now - self._last_hb_file_t >= 1.0:
+                self._last_hb_file_t = now
+                try:
+                    from .watchdog import publish_heartbeat_file
+
+                    publish_heartbeat_file(
+                        self.config.heartbeat_dir, self.process_index, step
+                    )
+                except OSError:
+                    pass
+
+    def _drain_compile(self) -> dict:
+        from ..utils.compile_cache import compile_event_counters
+
+        now = compile_event_counters()
+        mark, self._compile_mark = self._compile_mark, now
+        return {
+            "compile_events": now["count"] - mark["count"],
+            "compile_s": now["seconds"] - mark["seconds"],
+            "compile_cache_hits": now["cache_hits"] - mark["cache_hits"],
+        }
+
+    # -- consumers ---------------------------------------------------------
+
+    def _resolve(self, value):
+        if value is None:
+            return None
+        try:
+            import jax
+
+            return float(jax.device_get(value))
+        except Exception:
+            try:
+                return float(value)
+            except (TypeError, ValueError):
+                return None
+
+    def _write_step_record(self, rec: dict):
+        import json
+
+        if self._metrics_fh is None or self._metrics_fh.closed:
+            return
+        out = {k: v for k, v in rec.items() if not k.startswith("_") and v is not None}
+        out["time_unix_s"] = round(time.time(), 3)
+        if rec.get("tokens") and rec.get("wall_s"):
+            out["tokens_per_s"] = rec["tokens"] / rec["wall_s"]
+        if rec.get("flops") and rec.get("wall_s"):
+            out["mfu_pct"] = 100.0 * rec["flops"] / rec["wall_s"] / self.peak_flops()
+        loss = self._resolve(rec.get("_loss"))
+        if loss is not None:
+            out["loss"] = loss
+        gn = self._resolve(rec.get("_grad_norm"))
+        if gn is not None:
+            out["grad_norm"] = gn
+        self._metrics_fh.write(json.dumps(out) + "\n")
+        self._metrics_fh.flush()
+
+    def peak_flops(self) -> float:
+        if self._peak is None:
+            from .metrics import peak_flops
+
+            try:
+                import jax
+
+                self._peak = peak_flops(jax.devices()[0])
+            except Exception:
+                self._peak = 200e12
+        return self._peak
+
+    def rollup(self) -> dict:
+        """Aggregate the rolling window plus the engine-state gauges into
+        one flat dict of scalars (the ``log_system_metrics`` payload)."""
+        out = self.window.rollup(peak=self.peak_flops())
+        last = self.window.last()
+        if last is not None:
+            out["sys/step"] = last["step"]
+            loss = self._resolve(last.get("_loss"))
+            if loss is not None:
+                out["sys/loss"] = loss
+            gn = self._resolve(last.get("_grad_norm"))
+            if gn is not None:
+                out["sys/grad_norm"] = gn
+        for engine in self._engines:
+            if engine.scale_state is not None:
+                scale = self._resolve(engine.scale_state.get("scale"))
+                if scale is not None:
+                    out["sys/loss_scale"] = scale
+                out["sys/last_step_skipped"] = bool(engine.last_step_skipped())
+            extra = engine.extra_state
+            if isinstance(extra, dict) and "fp8_stats" in extra:
+                from .metrics import fp8_amax_health
+
+                out.update(fp8_amax_health(extra["fp8_stats"]))
+        if self._wire_bytes is not None:
+            out["sys/replica_wire_bytes_per_step"] = self._wire_bytes
+        if self.config.device_memory:
+            from .metrics import device_memory_stats
+
+            out.update(device_memory_stats())
+        return out
+
+    def flush(self, step: Optional[int] = None) -> dict:
+        """Rollup + push through the Accelerator's trackers (main-process
+        gating happens inside each tracker, so calling this everywhere is
+        safe). Returns the values."""
+        values = self.rollup()
+        if not values:
+            return values
+        acc = self._accelerator
+        if acc is not None and getattr(acc, "trackers", None):
+            if step is None:
+                step = values.get("sys/step")
+            acc.log(values, step=step)
+        return values
+
+    def close(self):
+        global _ACTIVE_SESSION
+        if self._closed:
+            return
+        self._closed = True
+        for engine in self._engines:
+            if getattr(engine, "telemetry", None) is self:
+                engine.telemetry = None
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.recorder is not None:
+            from . import spans as _spans
+
+            if _spans.recorder() is self.recorder:
+                _spans.disarm()
+            else:
+                self.recorder.close()
+        if self._metrics_fh is not None:
+            self._metrics_fh.close()
+        if _ACTIVE_SESSION is self:
+            _ACTIVE_SESSION = None

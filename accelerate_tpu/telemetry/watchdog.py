@@ -1,0 +1,217 @@
+"""Heartbeat / straggler watchdog: turns silent multi-chip hangs into
+diagnosable dumps.
+
+Each process publishes a monotonic step heartbeat into the shared-dict
+runtime state (``PartialState.publish_heartbeat`` — the same shared
+``__dict__`` every ``PartialState()`` instance reads, so the monitor
+thread sees beats without any coupling to the training loop). A daemon
+thread checks the beat's age every ``poll_s``; past ``deadline_s`` it
+fires ONCE per stalled step: a report with
+
+- this host's heartbeat (step, age),
+- every peer's heartbeat when a shared ``heartbeat_dir`` is configured
+  (each host also mirrors its beat to ``host-<i>.json`` there, throttled),
+  with stale peers flagged as stragglers — on a healthy-but-waiting host
+  this is what NAMES the hung peer,
+- the last-N closed telemetry spans (what the host was doing), and
+- a stack dump of every python thread (``sys._current_frames``).
+
+The report goes to stderr, to ``dump_dir/watchdog-host<i>.log`` when a
+dump dir is set, and to the ``on_stall`` callback. The watchdog re-arms
+as soon as the heartbeat advances, so a recovered straggler costs one
+report, not a stream.
+
+Why this instead of a collective timeout: a deadlocked GSPMD collective
+never returns, so the launched-script matrix's worst failure mode was an
+opaque ``timeout -k`` kill with zero evidence. The watchdog runs on the
+host clock, needs no device progress, and each host dumps its OWN stacks
+— comparing per-host dumps shows which rank stalled and where.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+
+def publish_heartbeat_file(heartbeat_dir: str, process_index: int, step: int):
+    """Mirror a heartbeat to the shared dir (atomic rename; peers poll it)."""
+    os.makedirs(heartbeat_dir, exist_ok=True)
+    path = os.path.join(heartbeat_dir, f"host-{process_index}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"process_index": process_index, "step": int(step),
+                   "time_unix_s": time.time()}, fh)
+    os.replace(tmp, path)
+
+
+def read_peer_heartbeats(heartbeat_dir: str) -> list:
+    """All host-*.json beats in the shared dir (unreadable files skipped)."""
+    out = []
+    try:
+        names = sorted(os.listdir(heartbeat_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("host-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(heartbeat_dir, name)) as fh:
+                out.append(json.load(fh))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def _thread_stacks() -> str:
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    chunks = []
+    for tid, frame in frames.items():
+        chunks.append(f"--- thread {names.get(tid, '?')} (ident {tid}) ---\n"
+                      + "".join(traceback.format_stack(frame)))
+    return "\n".join(chunks)
+
+
+def build_stall_report(step, age_s: float, deadline_s: float,
+                       process_index: int = 0,
+                       heartbeat_dir: Optional[str] = None,
+                       n_spans: int = 16) -> str:
+    """The full post-mortem text for one stall (also usable standalone)."""
+    from . import spans
+
+    lines = [
+        "== accelerate_tpu telemetry watchdog: STALL detected ==",
+        f"host/process {process_index}: last heartbeat step {step}, "
+        f"age {age_s:.1f}s > deadline {deadline_s:.1f}s "
+        f"(wall clock {time.strftime('%Y-%m-%d %H:%M:%S')})",
+    ]
+    if heartbeat_dir:
+        peers = read_peer_heartbeats(heartbeat_dir)
+        if peers:
+            now = time.time()
+            max_step = max(p.get("step", 0) for p in peers)
+            lines.append("peer heartbeats:")
+            for p in peers:
+                p_age = now - p.get("time_unix_s", now)
+                straggler = p_age > deadline_s or p.get("step", 0) < max_step - 1
+                lines.append(
+                    f"  host {p.get('process_index')}: step {p.get('step')} "
+                    f"(age {p_age:.1f}s)" + ("  <-- STRAGGLER" if straggler else "")
+                )
+        else:
+            lines.append(f"peer heartbeats: none readable in {heartbeat_dir}")
+    recent = spans.last_spans(n_spans)
+    if recent:
+        lines.append(f"last {len(recent)} spans before the stall (oldest first):")
+        for s in recent:
+            ago = time.time() - s["end_unix_s"]
+            lines.append(f"  {s['name']}  dur {s['dur_s'] * 1e3:.1f}ms  "
+                         f"ended {ago:.1f}s ago")
+    lines.append("python thread stacks:")
+    lines.append(_thread_stacks())
+    return "\n".join(lines)
+
+
+class HeartbeatWatchdog:
+    """Daemon monitor over the shared-dict heartbeat.
+
+    Fires at most once per stalled step (re-arms when the step advances).
+    ``stall_count`` / ``last_report`` expose what happened for tests and
+    callers that poll instead of passing ``on_stall``.
+    """
+
+    def __init__(
+        self,
+        deadline_s: float = 300.0,
+        poll_s: Optional[float] = None,
+        heartbeat_dir: Optional[str] = None,
+        dump_dir: Optional[str] = None,
+        on_stall: Optional[Callable[[str], None]] = None,
+        last_spans: int = 16,
+    ):
+        self.deadline_s = float(deadline_s)
+        self.poll_s = poll_s if poll_s is not None else max(0.05, self.deadline_s / 4)
+        self.heartbeat_dir = heartbeat_dir
+        self.dump_dir = dump_dir
+        self.on_stall = on_stall
+        self.n_spans = last_spans
+        self.stall_count = 0
+        self.last_report: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fired_for_step = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="att-telemetry-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.poll_s + 1.0)
+            self._thread = None
+
+    # -- monitor loop ------------------------------------------------------
+
+    @staticmethod
+    def _read_heartbeat():
+        from ..state import PartialState
+
+        return PartialState._shared_state.get("telemetry_heartbeat")
+
+    def _run(self):
+        while not self._stop.wait(self.poll_s):
+            hb = self._read_heartbeat()
+            if hb is None:
+                # no step yet: compiles/first-batch legitimately take longer
+                # than a step deadline, so the clock starts at the first beat
+                continue
+            step, beat_t = hb
+            if self._fired_for_step is not None and step != self._fired_for_step:
+                self._fired_for_step = None  # progress happened: re-arm
+            age = time.monotonic() - beat_t
+            if age > self.deadline_s and self._fired_for_step != step:
+                self._fired_for_step = step
+                self._fire(step, age)
+
+    def _fire(self, step, age):
+        from ..state import PartialState
+
+        idx = PartialState._shared_state.get("process_index", 0)
+        try:
+            report = build_stall_report(
+                step, age, self.deadline_s, process_index=idx,
+                heartbeat_dir=self.heartbeat_dir, n_spans=self.n_spans,
+            )
+        except Exception as e:  # the watchdog must never take the run down
+            report = f"watchdog stall at step {step} (report build failed: {e!r})"
+        self.stall_count += 1
+        self.last_report = report
+        print(report, file=sys.stderr)
+        if self.dump_dir:
+            try:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                with open(os.path.join(self.dump_dir, f"watchdog-host{idx}.log"),
+                          "a") as fh:
+                    fh.write(report + "\n\n")
+            except OSError:
+                pass
+        if self.on_stall is not None:
+            try:
+                self.on_stall(report)
+            except Exception:
+                pass

@@ -466,6 +466,10 @@ def _jsonable(values):
             out[k] = v.tolist()
         elif isinstance(v, dict):
             out[k] = _jsonable(v)
+        elif hasattr(v, "__array__") and not isinstance(v, (int, float, str, bool)):
+            # non-scalar device arrays (telemetry gauges, user extras):
+            # pull to host so json.dumps doesn't choke on jax.Array
+            out[k] = np.asarray(v).tolist()
         else:
             out[k] = v
     return out

@@ -11,11 +11,20 @@ executable instead of recompiling.
 ``ensure_persistent_compile_cache()`` is called by the dispatch path
 (big_modeling), generation, and the Accelerator when a CompilePlugin enables
 it; set ``ATT_COMPILE_CACHE=0`` to disable or to a path to relocate.
+
+This module also owns the **compile-activity counters** the telemetry
+session reads per step: ``install_compile_listeners()`` subscribes (once)
+to ``jax.monitoring``'s event streams and tallies backend-compile events,
+their total seconds, and persistent-cache hits. A step whose record shows
+``compile_events > 0`` paid a trace/compile — the classic silent cause of
+a 100x step-time outlier — and ``compile_cache_hits`` says whether the
+persistent cache absorbed it.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 
 _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "accelerate_tpu", "xla_cache"
@@ -67,3 +76,60 @@ def ensure_persistent_compile_cache(cache_dir: str | None = None) -> str | None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _enabled_dir = cache_dir
     return _enabled_dir
+
+
+# ---------------------------------------------------------------------------
+# compile-activity counters (consumed by telemetry at step cadence)
+# ---------------------------------------------------------------------------
+
+_counter_lock = threading.Lock()
+_COMPILE_COUNTERS = {"count": 0, "seconds": 0.0, "cache_hits": 0}
+_listeners_installed = False
+
+
+def compile_event_counters() -> dict:
+    """Monotonic process-wide counters: {count, seconds, cache_hits}.
+    Consumers diff two snapshots to attribute activity to an interval."""
+    with _counter_lock:
+        return dict(_COMPILE_COUNTERS)
+
+
+def record_compile_event(seconds: float = 0.0, cache_hit: bool = False):
+    """Tally one compile (or cache-hit) observation. Public so tests and
+    non-jax.monitoring paths can feed the same counters the listener does."""
+    with _counter_lock:
+        if cache_hit:
+            _COMPILE_COUNTERS["cache_hits"] += 1
+        else:
+            _COMPILE_COUNTERS["count"] += 1
+            _COMPILE_COUNTERS["seconds"] += float(seconds)
+
+
+def _on_event_duration(event, duration, **_kw):
+    name = str(event)
+    if "compile" in name and "cache" not in name:
+        record_compile_event(float(duration))
+
+
+def _on_event(event, **_kw):
+    name = str(event)
+    if "cache_hit" in name or ("cache" in name and "hit" in name):
+        record_compile_event(cache_hit=True)
+
+
+def install_compile_listeners() -> bool:
+    """Subscribe the counters to jax.monitoring (idempotent). Returns False
+    when this jax build has no monitoring hooks — counters then only move
+    through explicit record_compile_event calls."""
+    global _listeners_installed
+    if _listeners_installed:
+        return True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+        monitoring.register_event_listener(_on_event)
+    except Exception:  # pragma: no cover - jax without monitoring
+        return False
+    _listeners_installed = True
+    return True

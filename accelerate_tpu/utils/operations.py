@@ -198,18 +198,28 @@ def make_global_batch(
 # in-jit collectives (mesh-axis wrappers)
 # ---------------------------------------------------------------------------
 
+def _axis_is_bound(name) -> bool:
+    """True iff ``name`` is a mapped axis in the current trace context.
+    ``jax.lax.axis_size`` where available; ``core.axis_frame`` (raises on
+    unbound names) on older jax builds without it."""
+    try:
+        probe = jax.lax.axis_size
+    except AttributeError:
+        import jax.core as _core
+
+        probe = _core.axis_frame
+    try:
+        probe(name)
+        return True
+    except (NameError, KeyError, Exception):
+        return False
+
+
 def _active_axes(axis_names):
     """Filter axis names down to those bound in the current trace context."""
     if isinstance(axis_names, str):
         axis_names = (axis_names,)
-    out = []
-    for a in axis_names:
-        try:
-            jax.lax.axis_size(a)
-            out.append(a)
-        except (NameError, KeyError, Exception):
-            continue
-    return tuple(out)
+    return tuple(a for a in axis_names if _axis_is_bound(a))
 
 
 def psum(x, axis_names=("replica", "data", "fsdp")):

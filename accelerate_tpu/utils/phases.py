@@ -1,10 +1,19 @@
-"""Lightweight phase timing for the dispatch/TTFT path.
+"""Lightweight phase timing for the dispatch/TTFT path — now a thin veneer
+over the telemetry span layer.
 
-Off by default (a no-op context manager); ``collect_phases()`` arms a
-process-global collector that accumulates wall time per named phase —
-bench.py's TTFT worker uses it to publish WHERE dispatch time goes
-(checkpoint read / host quantize / transfer submit / compile / first
-forward) instead of a single opaque total.
+Two consumers, two shapes:
+
+- ``collect_phases()`` arms a process-global collector that accumulates
+  wall time per named phase — bench.py's TTFT worker uses it to publish
+  WHERE dispatch time goes (checkpoint read / host quantize / transfer
+  submit / compile / first forward) instead of a single opaque total.
+- when a telemetry span recorder is armed (``telemetry.spans.arm`` or a
+  ``TelemetrySession`` with spans on), every ``phase(...)`` additionally
+  lands in the per-host Chrome-trace JSONL as a nested span, so the TTFT
+  breakdown and a training run's spans share one timeline format.
+
+Both are off by default: with neither armed, ``phase`` is a no-op
+context manager (two global reads).
 """
 
 from __future__ import annotations
@@ -29,14 +38,22 @@ def phases_snapshot() -> dict:
 
 @contextmanager
 def phase(name: str):
-    if _ACTIVE is None:
+    from ..telemetry import spans as _spans
+
+    rec = _spans.recorder()
+    if _ACTIVE is None and rec is None:
         yield
         return
     t0 = time.perf_counter()
     try:
-        yield
+        if rec is not None:
+            with _spans.span(name, cat="phase"):
+                yield
+        else:
+            yield
     finally:
-        _ACTIVE[name] = _ACTIVE.get(name, 0.0) + (time.perf_counter() - t0)
+        if _ACTIVE is not None:
+            _ACTIVE[name] = _ACTIVE.get(name, 0.0) + (time.perf_counter() - t0)
 
 
 def add_phase(name: str, seconds: float) -> None:

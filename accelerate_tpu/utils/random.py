@@ -64,6 +64,7 @@ class KeyChain:
             # ATT_PRNG_IMPL=threefry2x32 for cross-backend bitwise
             # reproducibility of the random streams instead.
             self._impl = "rbg" if jax.default_backend() == "tpu" else None
+            _log_resolved_impl(self._impl)
         return self._impl
 
     def next_key(self, name: str = "default") -> jax.Array:
@@ -81,6 +82,29 @@ class KeyChain:
     def load_state_dict(self, state: dict):
         self._seed = int(state["seed"])
         self._counters = dict(state["counters"])
+
+
+_IMPL_LOGGED = False
+
+
+def _log_resolved_impl(impl):
+    """One line at first auto-resolution: the rbg-on-TPU default means the
+    random STREAMS differ between a TPU run and its CPU-sim replay, which
+    otherwise surfaces only as mysterious numeric drift in parity debugging
+    (ADVICE r5). Explicit ATT_PRNG_IMPL settings skip this (user chose)."""
+    global _IMPL_LOGGED
+    if _IMPL_LOGGED:
+        return
+    _IMPL_LOGGED = True
+    import logging
+
+    logging.getLogger(__name__).info(
+        "KeyChain PRNG impl resolved to %s on the %r backend; random "
+        "streams are NOT bitwise-comparable across impls (set "
+        "ATT_PRNG_IMPL=threefry2x32 for cross-backend reproducibility).",
+        repr(impl) if impl else "the jax default (threefry2x32)",
+        jax.default_backend(),
+    )
 
 
 def _stable_hash(name: str) -> int:

@@ -45,25 +45,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# bf16 peak FLOP/s per chip by device kind (public spec sheets)
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5": 459e12,  # v5p
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v6 lite": 918e12,  # v6e / Trillium
-    "TPU v6e": 918e12,
-    "TPU v7": 2307e12,  # Ironwood (bf16)
-}
-
-
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
-    # most-specific (longest) name first: "TPU v5 lite" must win over "TPU v5"
-    for name, flops in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
-        if name.lower() in kind:
-            return flops
-    return 200e12  # conservative default for unknown TPU; CPU runs report vs this
+# The flops accounting (peak table + decoder FLOPs/token) lives in
+# telemetry.metrics so a LIVE training run reports the same MFU this
+# benchmark computes offline — one definition, two consumers. The aliases
+# keep this file's call sites (and any external users) unchanged.
+from accelerate_tpu.telemetry.metrics import (  # noqa: E402
+    PEAK_FLOPS,  # noqa: F401 (re-export)
+    decoder_flops_per_token,
+    peak_flops as _peak_flops,
+)
 
 
 def _named_configs(on_tpu: bool):
@@ -104,8 +94,15 @@ def _timed_steps(step, batch, steps, windows: int = 1):
     return loss, best
 
 
-def _train_bench(cfg, batch_size, seq_len, steps, mixed_precision):
-    """Train `steps` steps, return (tokens/sec, MFU, final loss)."""
+def _train_bench(cfg, batch_size, seq_len, steps, mixed_precision, telemetry_out=None):
+    """Train `steps` steps, return (tokens/sec, MFU, final loss).
+
+    ``telemetry_out`` arms the runtime telemetry session with a per-step
+    metrics JSONL at that exact path (step wall time, tokens/s, live MFU
+    — the same records a production run gets), written by the engine as
+    the bench runs; the headline numbers below stay measured by
+    ``_timed_steps``'s forced-device_get windows, which remain correct on
+    remote-attached runtimes where dispatch returns before compute."""
     import optax
 
     from accelerate_tpu import Accelerator, Model
@@ -113,7 +110,13 @@ def _train_bench(cfg, batch_size, seq_len, steps, mixed_precision):
     from accelerate_tpu.state import AcceleratorState
 
     AcceleratorState._reset_state(reset_partial_state=False)
-    accelerator = Accelerator(mixed_precision=mixed_precision)
+    telemetry = None
+    if telemetry_out:
+        from accelerate_tpu.telemetry import TelemetryConfig
+
+        telemetry = TelemetryConfig(metrics_path=telemetry_out, spans=False,
+                                    window=max(64, steps))
+    accelerator = Accelerator(mixed_precision=mixed_precision, telemetry=telemetry)
     model_def = DecoderLM(cfg, mesh=accelerator.mesh)
     variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=batch_size, seq_len=seq_len)
     model, optimizer = accelerator.prepare(
@@ -129,8 +132,12 @@ def _train_bench(cfg, batch_size, seq_len, steps, mixed_precision):
     final_loss, dt = _timed_steps(step, batch, steps)
     tokens_per_sec = batch_size * seq_len * steps / dt
     # FLOPs/token: 6N weight FLOPs + causal attention 6*L*S*E
-    flops_per_token = 6 * cfg.num_params + 6 * cfg.num_layers * seq_len * cfg.embed_dim
+    flops_per_token = decoder_flops_per_token(
+        cfg.num_params, cfg.num_layers, seq_len, cfg.embed_dim
+    )
     mfu = tokens_per_sec * flops_per_token / _peak_flops(jax.devices()[0])
+    if accelerator.telemetry is not None:
+        accelerator.telemetry.close()
     return tokens_per_sec, mfu, final_loss, dt / steps
 
 
@@ -483,6 +490,10 @@ def main():
                         help="internal: quantize-on-load for the TTFT attempt")
     parser.add_argument("--_pipeline_mem", action="store_true",
                         help="internal: print gpipe-vs-1f1b compiled temp bytes")
+    parser.add_argument("--telemetry-out", default=None, metavar="PATH",
+                        help="write the headline train bench's per-step runtime-"
+                             "telemetry records (step wall, tokens/s, live MFU) "
+                             "as JSONL at PATH — drop it next to BENCH_*.json")
     args, _ = parser.parse_known_args()
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -524,7 +535,9 @@ def main():
             dtype=jnp.bfloat16, remat=True, remat_policy="save_dots",
             scan_layers=True,
         )
-        tok_s, mfu, _, step_ms = _train_bench(flagship, 8, 2048, 20, "bf16")
+        tok_s, mfu, _, step_ms = _train_bench(
+            flagship, 8, 2048, 20, "bf16", telemetry_out=args.telemetry_out
+        )
 
         # the BASELINE nlp_example / cv_example rows (samples/sec/chip).
         # These run EARLY: their sub-second steps make them the most
@@ -607,7 +620,9 @@ def main():
             extra["pipeline_1f1b_temp_mb"] = round(mem["1f1b"] / 1e6, 1)
     else:
         cfg = DecoderConfig.tiny(max_seq_len=256)
-        tok_s, mfu, _, step_ms = _train_bench(cfg, 4, 128, 5, "no")
+        tok_s, mfu, _, step_ms = _train_bench(
+            cfg, 4, 128, 5, "no", telemetry_out=args.telemetry_out
+        )
         import tempfile
 
         tiny = _named_configs(False)["ttft_tiny"]

@@ -445,6 +445,13 @@ class TestHostOffload:
             offload_optimizer_state=True, offload_params_to_host=True
         )
         assert losses[-1] < losses[0], losses
+        from accelerate_tpu.parallel.sharding import _memory_kind_available
+
+        if not _memory_kind_available("pinned_host"):
+            pytest.skip(
+                "backend exposes no pinned_host memory kind; offload "
+                "degrades to device residency (training above still passes)"
+            )
         for tree in (model._engine.opt_state, model._engine.params):
             kinds = {
                 getattr(l.sharding, "memory_kind", None)

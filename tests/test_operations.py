@@ -51,7 +51,7 @@ def test_gather_object_single_process():
 
 
 def test_psum_inside_shard_map():
-    from jax import shard_map
+    from accelerate_tpu.parallel.sharding import shard_map_compat
 
     state = AcceleratorState()
     mesh = state.mesh
@@ -60,7 +60,7 @@ def test_psum_inside_shard_map():
     def f(x):
         return ops.psum(jnp.sum(x), ("data",))
 
-    out = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P())(x)
+    out = shard_map_compat(f, mesh=mesh, in_specs=P("data"), out_specs=P())(x)
     assert float(out) == 28.0
 
 
