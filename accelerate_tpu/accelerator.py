@@ -1397,6 +1397,42 @@ class TrainEngine:
         return state
 
 
+_fp8_mxu_warned = False
+
+
+def _device_has_fp8_mxu(device) -> bool:
+    """fp8 MXU throughput arrives with v6e (Trillium); v5e/v5p and older
+    emulate fp8 matmuls via convert-to-bf16 (docs/fp8.md)."""
+    import re
+
+    kind = getattr(device, "device_kind", "") or ""
+    m = re.search(r"tpu\s*v(\d+)", kind.lower())
+    return bool(m) and int(m.group(1)) >= 6
+
+
+def _warn_fp8_without_mxu_once(device) -> None:
+    """One loud notice when mixed_precision='fp8' lands on hardware that
+    only emulates fp8: the user just bought overhead, not speed (measured
+    ~11pp MFU below bf16 on v5e — BENCH fp8 row), and nothing else at
+    runtime says so. The recipe itself stays numerically valid, so this is
+    a warning, not an error; the same code path speeds up on v6e+."""
+    global _fp8_mxu_warned
+    if _fp8_mxu_warned or _device_has_fp8_mxu(device):
+        return
+    _fp8_mxu_warned = True
+    import warnings
+
+    kind = getattr(device, "device_kind", "unknown device")
+    warnings.warn(
+        f"mixed_precision='fp8' on {kind!r}: this chip has no fp8 MXU, so "
+        "XLA emulates fp8 matmuls via convert and training runs SLOWER "
+        "than bf16 (see docs/fp8.md, 'When to use it'). The recipe is "
+        "numerically faithful and transfers to v6e+/Ironwood unchanged; "
+        "use mixed_precision='bf16' here if you want throughput.",
+        stacklevel=3,
+    )
+
+
 def _enable_fp8(definition):
     """Flip ``config.use_fp8`` on a model definition that supports the fp8
     recipe (ops/fp8.py); definitions without the knob pass through — their
@@ -1566,6 +1602,8 @@ class Accelerator:
         )
         if self.scaler_handler is not None:
             self.state.precision.grad_scaler = self.scaler_handler
+        if self.state.mixed_precision == "fp8":
+            _warn_fp8_without_mxu_once(self.state.device)
 
         if gradient_accumulation_plugin is None:
             gradient_accumulation_plugin = GradientAccumulationPlugin(

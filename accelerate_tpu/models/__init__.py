@@ -16,23 +16,41 @@ so we ship first-class implementations:
 - ``Seq2SeqLM`` — T5-family encoder-decoder with flash cross-attention
   and cached seq2seq generation (reference `utils/megatron_lm.py`
   T5TrainStep target).
+
+Lazy (PEP 562) on purpose: the config classes import in milliseconds while
+the model modules pull flax.linen (~0.5 s of sole-core CPU). The dispatch
+TTFT worker pays every import before its first byte moves — importing
+``DecoderConfig`` must not bill for the encoder/seq2seq/vision families it
+never touches (``proc_startup_imports`` in the bench phase breakdown).
 """
 
-from .configs import DecoderConfig, EncoderConfig, VisionConfig
-from .decoder import DecoderLM
-from .encoder import EncoderClassifier
-from .moe import MoeMLP
-from .seq2seq import Seq2SeqConfig, Seq2SeqLM
-from .vision import ResNet
+_LAZY = {
+    "DecoderConfig": "configs",
+    "EncoderConfig": "configs",
+    "VisionConfig": "configs",
+    "DecoderLM": "decoder",
+    "EncoderClassifier": "encoder",
+    "MoeMLP": "moe",
+    "Seq2SeqConfig": "seq2seq",
+    "Seq2SeqLM": "seq2seq",
+    "ResNet": "vision",
+}
 
-__all__ = [
-    "DecoderConfig",
-    "EncoderConfig",
-    "VisionConfig",
-    "Seq2SeqConfig",
-    "DecoderLM",
-    "EncoderClassifier",
-    "MoeMLP",
-    "ResNet",
-    "Seq2SeqLM",
-]
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        modname = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    module = importlib.import_module(f".{modname}", __name__)
+    value = getattr(module, name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -414,7 +414,7 @@ class DecoderLM(nn.Module):
             num_micro = _adapt_microbatches(
                 b, cfg.pipeline_microbatches or num_stages, num_stages
             )
-            x_mb = split_microbatches(x, num_micro)
+            x_mb = split_microbatches(x, num_micro, mesh=self.mesh)
             moe = cfg.moe_num_experts > 1
             out = PipelineStages(
                 stage_module=StageStack,
@@ -530,7 +530,7 @@ class DecoderLM(nn.Module):
             )
             stage_params = params["pipeline"]["schedule"]["stages"]
             outer = {k: v for k, v in params.items() if k != "pipeline"}
-            labels_mb = split_microbatches(labels, M)
+            labels_mb = split_microbatches(labels, M, mesh=mesh)
             # per-microbatch valid-token share of the global mean (shifted
             # labels: position i predicts token i+1, so column 0 never counts)
             counts = jnp.sum(labels_mb[:, :, 1:] != -100, axis=(1, 2)).astype(jnp.float32)
@@ -538,7 +538,7 @@ class DecoderLM(nn.Module):
 
             def embed_fn(outer_p, ids):
                 x = _embed_lookup(outer_p["embedding"], ids, cfg, mesh)
-                return split_microbatches(x, M)
+                return split_microbatches(x, M, mesh=mesh)
 
             with_dropout = cfg.dropout_rate > 0 and rng is not None
 

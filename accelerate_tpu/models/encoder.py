@@ -10,6 +10,7 @@ mesh strategies apply unchanged.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import flax.linen as nn
@@ -21,6 +22,29 @@ from ..ops.attention import dot_product_attention
 from ..ops.losses import softmax_cross_entropy
 from .configs import EncoderConfig
 from .decoder import _constrain, _dense_init
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _embed_gather(vocab: int, table, ids):
+    """Embedding gather whose BACKWARD is a one-hot contraction instead of
+    a scatter-add. The scatter's cotangent must match the table's sharding
+    (embed over fsdp), which the batch-sharded activation cotangent cannot
+    reach without an "[SPMD] Involuntary full rematerialization"; a matmul
+    grad the partitioner shards natively (psum over batch shards, output
+    born in the table's layout)."""
+    return jnp.take(table, ids, axis=0)
+
+
+def _embed_gather_fwd(vocab, table, ids):
+    return jnp.take(table, ids, axis=0), ids
+
+
+def _embed_gather_bwd(vocab, ids, g):
+    onehot = jax.nn.one_hot(ids, vocab, dtype=g.dtype)
+    return jnp.einsum("...v,...e->ve", onehot, g), None
+
+
+_embed_gather.defvjp(_embed_gather_fwd, _embed_gather_bwd)
 
 
 def _layer_norm(x, scale, bias, eps):
@@ -104,6 +128,15 @@ class EncoderClassifier(nn.Module):
         deterministic: bool = True,
     ):
         cfg = self.config
+        if self.mesh is not None and self.mesh.shape.get("stage", 1) > 1:
+            raise NotImplementedError(
+                "EncoderClassifier does not support pipeline parallelism: "
+                f"the mesh has a 'stage' axis of size {self.mesh.shape['stage']} "
+                "but encoder-only models have no stage split (running anyway "
+                "would silently replicate every layer on every stage). Use "
+                "DecoderLM or Seq2SeqLM for pipeline stages, or drop "
+                "pipeline_parallel from ShardingConfig for BERT-family models."
+            )
         b, s = input_ids.shape
         word = self.param(
             "word_embedding",
@@ -126,9 +159,9 @@ class EncoderClassifier(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         x = (
-            jnp.take(word, input_ids, axis=0)
+            _embed_gather(cfg.vocab_size, word, input_ids)
             + pos[None, :s]
-            + jnp.take(typ, token_type_ids, axis=0)
+            + _embed_gather(cfg.type_vocab_size, typ, token_type_ids)
         )
         x = _layer_norm(x.astype(cfg.dtype), ln_s, ln_b, cfg.norm_eps)
         x = _constrain(x, ("batch", "seq", "embed"), self.mesh)
@@ -145,10 +178,17 @@ class EncoderClassifier(nn.Module):
         for i in range(cfg.num_layers):
             x = body(cfg, self.mesh, name=f"layer_{i}")(x, kv_mask, deterministic)
 
-        # BERT pooler: tanh(dense(CLS))
+        # BERT pooler: tanh(dense(CLS)). The CLS slice and pooled output are
+        # pinned to the batch spec: without the anchors the partitioner
+        # propagates the pooler/classifier kernels' fsdp layout backward onto
+        # the encoder activations (embed-split, data-replicated — a device
+        # order the batch layout can't reach), which surfaces as
+        # "[SPMD] Involuntary full rematerialization" on fsdp meshes.
         wp = self.param("pooler_kernel", nn.with_logical_partitioning(_dense_init(), ("embed", "embed")), (cfg.embed_dim, cfg.embed_dim))
         bp = self.param("pooler_bias", nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)), (cfg.embed_dim,))
-        pooled = jnp.tanh(x[:, 0] @ wp.astype(cfg.dtype) + bp.astype(cfg.dtype))
+        cls = _constrain(x[:, 0], ("batch", "embed"), self.mesh)
+        pooled = jnp.tanh(cls @ wp.astype(cfg.dtype) + bp.astype(cfg.dtype))
+        pooled = _constrain(pooled, ("batch", "embed"), self.mesh)
         if cfg.dropout_rate > 0.0:
             pooled = nn.Dropout(cfg.dropout_rate)(pooled, deterministic=deterministic)
 

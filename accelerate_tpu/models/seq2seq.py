@@ -443,13 +443,13 @@ class _Decoder(nn.Module):
                 b, cfg.pipeline_microbatches or num_stages, num_stages
             )
             buf_mb = jnp.concatenate(
-                [split_microbatches(x, num_micro), split_microbatches(enc, num_micro)],
+                [split_microbatches(x, num_micro, mesh=self.mesh), split_microbatches(enc, num_micro, mesh=self.mesh)],
                 axis=2,
             )
             consts = (sin, cos, deterministic)
             n_mb_consts = 0
             if enc_mask is not None:
-                consts = consts + (split_microbatches(enc_mask, num_micro),)
+                consts = consts + (split_microbatches(enc_mask, num_micro, mesh=self.mesh),)
                 n_mb_consts = 1
             out = PipelineStages(
                 stage_module=Seq2SeqStageStack,
@@ -672,13 +672,13 @@ class Seq2SeqLM(nn.Module):
 
             def dec_embed_fn(emb):
                 return split_microbatches(
-                    _embed_lookup(emb, decoder_input_ids, cfg, mesh), M
+                    _embed_lookup(emb, decoder_input_ids, cfg, mesh), M, mesh=mesh
                 )
 
             x_mb = dec_embed_fn(params["embedding"])
-            buf_mb = jnp.concatenate([x_mb, split_microbatches(mem, M)], axis=2)
+            buf_mb = jnp.concatenate([x_mb, split_microbatches(mem, M, mesh=mesh)], axis=2)
 
-            labels_mb = split_microbatches(labels, M)
+            labels_mb = split_microbatches(labels, M, mesh=mesh)
             counts = jnp.sum(labels_mb != -100, axis=(1, 2)).astype(jnp.float32)
             weights = counts / jnp.maximum(jnp.sum(counts), 1.0)
 

@@ -459,7 +459,7 @@ def one_f_one_b(
     return aux, grads, dx_mb
 
 
-def split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
+def split_microbatches(x: jax.Array, num_microbatches: int, mesh=None) -> jax.Array:
     """[B, ...] -> [M, B/M, ...], microbatch m = rows {m, m+M, m+2M, ...}.
 
     The STRIDED assignment is deliberate: the batch dim is sharded over the
@@ -469,13 +469,33 @@ def split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
     puts the sharding on the schedule dim M, which the SPMD partitioner can
     only undo by full rematerialization (the round-1 dryrun warning).
     merge_microbatches inverts exactly, so training semantics are
-    unaffected (row order within the global batch is restored)."""
+    unaffected (row order within the global batch is restored).
+
+    ``mesh``: when the per-microbatch row count B/M does NOT divide by the
+    batch-sharding axes, the partitioner's lowering of this reshape is
+    numerically WRONG on the pinned jax build (observed: pipelined forward
+    diverging ~0.5 absolute from dense with mb=2 rows over data=4 — not a
+    warning, silent corruption). Passing the mesh replicates the batch dim
+    first in exactly that degenerate case (tiny batches only; divisible
+    splits keep their sharding and take the fast path)."""
     b = x.shape[0]
     if b % num_microbatches != 0:
         raise ValueError(
             f"batch {b} is not divisible by num_microbatches={num_microbatches}"
         )
     mb = b // num_microbatches
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        batch_shards = 1
+        for ax in ("replica", "data", "fsdp"):
+            n = mesh.shape.get(ax, 1)
+            if b % (batch_shards * n) == 0:
+                batch_shards *= n
+        if mb % batch_shards != 0:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*([None] * x.ndim)))
+            )
     return x.reshape(mb, num_microbatches, *x.shape[1:]).swapaxes(0, 1)
 
 

@@ -59,18 +59,25 @@ class TestMicrobatchHelpers:
 
 
 class TestPipelineParity:
+    _dense_cache: dict = {}
+
     def _models_and_params(self, num_stages, num_micro, mesh=None):
-        cfg_dense = _cfg(scan_layers=True)
-        cfg_pipe = _cfg(pipeline_stages=num_stages, pipeline_microbatches=num_micro)
-        dense = DecoderLM(cfg_dense, mesh)
-        pipe = DecoderLM(cfg_pipe, mesh)
-        rng = jax.random.PRNGKey(0)
-        ids = jnp.zeros((4, 16), jnp.int32)
-        dense_vars = dense.init(rng, ids)
-        pipe_vars = pipe.init(rng, ids)
         from accelerate_tpu.parallel.sharding import unbox_params
 
-        dense_raw, _ = unbox_params(dense_vars["params"])
+        cfg_dense = _cfg(scan_layers=True)
+        cfg_pipe = _cfg(pipeline_stages=num_stages, pipeline_microbatches=num_micro)
+        rng = jax.random.PRNGKey(0)
+        ids = jnp.zeros((4, 16), jnp.int32)
+        # the dense side is identical across the parametrized combos — init
+        # it once per mesh (pure jax data, immune to the state resets)
+        cache_key = id(mesh)
+        if cache_key not in self._dense_cache:
+            dense = DecoderLM(cfg_dense, mesh)
+            dense_raw, _ = unbox_params(dense.init(rng, ids)["params"])
+            type(self)._dense_cache[cache_key] = (dense, dense_raw)
+        dense, dense_raw = self._dense_cache[cache_key]
+        pipe = DecoderLM(cfg_pipe, mesh)
+        pipe_vars = pipe.init(rng, ids)
         pipe_raw, _ = unbox_params(pipe_vars["params"])
         mapped = _dense_to_pipelined(dense_raw, pipe_raw, num_stages)
         return dense, pipe, dense_raw, mapped
@@ -243,7 +250,56 @@ class TestMicrobatchAdaptation:
 class TestOneFOneB:
     """1F1B schedule (parallel/pipeline.one_f_one_b): manual interleaved
     backward matching AD exactly, with an O(S) — not O(M) — activation
-    stash (reference Megatron 1F1B analog, megatron_lm.py:926-1033)."""
+    stash (reference Megatron 1F1B analog, megatron_lm.py:926-1033).
+
+    The decoder tests share ONE warm model/params/vag build (class-scoped
+    fixtures — pure jax data, so the per-test state reset cannot stale it):
+    the grads-parity, loss-scale, and uneven-padding tests all use the same
+    S=2 stage net, and the two dropout tests share a second build. This
+    module is the suite's biggest compile bill (tests/TIMINGS.md)."""
+
+    @pytest.fixture(scope="class")
+    def shared_1f1b(self):
+        """(cfg, params, vag, ids, l0, g0): the S=2/M=4 decoder, its 1f1b
+        value-and-grad, and one unscaled baseline run on clean labels."""
+        import dataclasses
+
+        from accelerate_tpu.parallel.sharding import unbox_params
+
+        cfg = dataclasses.replace(
+            _cfg(num_layers=4), pipeline_stages=2, pipeline_microbatches=4,
+            remat=False, dtype=jnp.float32,
+        )
+        model = DecoderLM(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0, cfg.vocab_size)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((8, 16), jnp.int32))
+        params, _ = unbox_params(variables["params"])
+        vag = DecoderLM(
+            dataclasses.replace(cfg, pipeline_schedule="1f1b")
+        ).pipeline_value_and_grad()
+        assert vag is not None
+        jvag = jax.jit(vag)
+        l0, g0 = jvag(params, ids, ids)
+        return cfg, model, params, jvag, ids, l0, g0
+
+    @pytest.fixture(scope="class")
+    def shared_1f1b_dropout(self):
+        """(cfg, params, vag) for the dropout-configured S=2/M=2 decoder."""
+        import dataclasses
+
+        from accelerate_tpu.parallel.sharding import unbox_params
+
+        cfg = dataclasses.replace(
+            _cfg(num_layers=4), pipeline_stages=2, pipeline_microbatches=2,
+            pipeline_schedule="1f1b", dropout_rate=0.2, remat=False,
+            dtype=jnp.float32,
+        )
+        model = DecoderLM(cfg)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32))
+        params, _ = unbox_params(variables["params"])
+        vag = model.pipeline_value_and_grad()
+        assert vag is not None
+        return cfg, params, vag
 
     def test_toy_stage_net_matches_ad(self):
         from accelerate_tpu.parallel.pipeline import one_f_one_b
@@ -297,31 +353,14 @@ class TestOneFOneB:
             np.asarray(dx_mb), np.asarray(ref_dx_mb), rtol=1e-4, atol=1e-6
         )
 
-    def test_decoder_1f1b_matches_gpipe_grads(self):
-        import dataclasses
-
-        from accelerate_tpu.parallel.sharding import unbox_params
-
-        cfg = dataclasses.replace(
-            _cfg(num_layers=4), pipeline_stages=2, pipeline_microbatches=4,
-            remat=False, dtype=jnp.float32,
-        )
-        model = DecoderLM(cfg)
-        ids = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0, cfg.vocab_size)
-        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((8, 16), jnp.int32))
-        params, _ = unbox_params(variables["params"])
+    def test_decoder_1f1b_matches_gpipe_grads(self, shared_1f1b):
+        cfg, model, params, _jvag, ids, l, g = shared_1f1b
 
         ref_l, ref_g = jax.jit(
             jax.value_and_grad(
                 lambda p: model.apply({"params": p}, ids, labels=ids)["loss"]
             )
         )(params)
-
-        vag = DecoderLM(
-            dataclasses.replace(cfg, pipeline_schedule="1f1b")
-        ).pipeline_value_and_grad()
-        assert vag is not None
-        l, g = jax.jit(vag)(params, ids, ids)
 
         np.testing.assert_allclose(float(l), float(ref_l), rtol=2e-5)
         fr, f1 = _flat(ref_g), _flat(g)
@@ -332,28 +371,14 @@ class TestOneFOneB:
             err = np.abs(a - b).max() / (np.abs(a).max() + 1e-8)
             assert err < 2e-4, (k, err)
 
-    def test_1f1b_loss_scale_seeds_backward(self):
+    def test_1f1b_loss_scale_seeds_backward(self, shared_1f1b):
         """fp16 loss scaling must run the MANUAL backward in the scaled
         domain (advisor r4): vag(..., scale=s) returns s * vag(...) grads and
         an unchanged loss."""
-        import dataclasses
-
-        from accelerate_tpu.parallel.sharding import unbox_params
-
-        cfg = dataclasses.replace(
-            _cfg(num_layers=4), pipeline_stages=2, pipeline_microbatches=2,
-            pipeline_schedule="1f1b", remat=False, dtype=jnp.float32,
-        )
-        model = DecoderLM(cfg)
-        ids = jax.random.randint(jax.random.PRNGKey(7), (4, 16), 0, cfg.vocab_size)
-        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32))
-        params, _ = unbox_params(variables["params"])
-        vag = model.pipeline_value_and_grad()
-        assert vag is not None
-
-        l0, g0 = jax.jit(vag)(params, ids, ids)
+        cfg, model, params, jvag, ids, l0, g0 = shared_1f1b
         s = jnp.asarray(512.0, jnp.float32)
-        l1, g1 = jax.jit(lambda p, i, t: vag(p, i, t, scale=s))(params, ids, ids)
+        vag_fn = jvag.__wrapped__
+        l1, g1 = jax.jit(lambda p, i, t: vag_fn(p, i, t, scale=s))(params, ids, ids)
         np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
         f0, f1 = _flat(g0), _flat(g1)
         for k in f0:
@@ -361,38 +386,23 @@ class TestOneFOneB:
                 np.asarray(f1[k]), 512.0 * np.asarray(f0[k]), rtol=1e-4, atol=1e-6
             )
 
-    def test_decoder_1f1b_matches_gpipe_with_uneven_ignore_padding(self):
+    def test_decoder_1f1b_matches_gpipe_with_uneven_ignore_padding(self, shared_1f1b):
         """Loss is the GLOBAL mean over non-ignored tokens in both schedules:
         per-microbatch means must be valid-token-share weighted, or uneven
         -100 padding across microbatches skews 1f1b (round-4 review)."""
-        import dataclasses
-
-        from accelerate_tpu.parallel.sharding import unbox_params
-
-        cfg = dataclasses.replace(
-            _cfg(num_layers=4), pipeline_stages=2, pipeline_microbatches=4,
-            remat=False, dtype=jnp.float32,
-        )
-        model = DecoderLM(cfg)
-        rng = np.random.RandomState(5)
-        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)), jnp.int32)
+        cfg, model, params, jvag, ids, _, _ = shared_1f1b
         labels = np.asarray(ids).copy()
         # heavy padding on some rows only -> microbatch token counts differ
         labels[::3, 6:] = -100
         labels[1, 2:] = -100
         labels = jnp.asarray(labels)
-        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((8, 16), jnp.int32))
-        params, _ = unbox_params(variables["params"])
 
         ref_l, ref_g = jax.jit(
             jax.value_and_grad(
                 lambda p: model.apply({"params": p}, ids, labels=labels)["loss"]
             )
         )(params)
-        vag = DecoderLM(
-            dataclasses.replace(cfg, pipeline_schedule="1f1b")
-        ).pipeline_value_and_grad()
-        l, g = jax.jit(vag)(params, ids, labels)
+        l, g = jvag(params, ids, labels)
 
         np.testing.assert_allclose(float(l), float(ref_l), rtol=2e-5)
         fr, f1 = _flat(ref_g), _flat(g)
@@ -423,35 +433,24 @@ class TestOneFOneB:
         cfg2 = dataclasses.replace(_cfg(), pipeline_schedule="1f1b")
         assert DecoderLM(cfg2).pipeline_value_and_grad() is None
 
-    def test_1f1b_dropout_matches_sequential_reference(self):
+    def test_1f1b_dropout_matches_sequential_reference(self, shared_1f1b_dropout):
         """Dropout in 1F1B (round-4 weak #5, Megatron per-microbatch RNG
         parity): the schedule derives one key per (stage, microbatch) and
         reuses it in the remat backward. Grads must equal an AD reference
         that runs the stages SEQUENTIALLY with the same key derivation —
         which can only hold if each pair's forward and backward sampled the
         same masks."""
-        import dataclasses
-
         from accelerate_tpu.models.decoder import (
             StageStack,
             _embed_lookup,
             _head_ce_loss,
         )
         from accelerate_tpu.ops.layers import rotary_embedding_tables
-        from accelerate_tpu.parallel.sharding import unbox_params
         from accelerate_tpu.parallel.pipeline import split_microbatches
 
-        S, M = 2, 2
-        cfg = dataclasses.replace(
-            _cfg(num_layers=4), pipeline_stages=S, pipeline_microbatches=M,
-            pipeline_schedule="1f1b", dropout_rate=0.2, remat=False,
-            dtype=jnp.float32,
-        )
-        model = DecoderLM(cfg)
+        cfg, params, vag = shared_1f1b_dropout
+        S, M = cfg.pipeline_stages, cfg.pipeline_microbatches
         ids = jax.random.randint(jax.random.PRNGKey(11), (4, 16), 0, cfg.vocab_size)
-        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32))
-        params, _ = unbox_params(variables["params"])
-        vag = model.pipeline_value_and_grad()
         key = jax.random.PRNGKey(42)
         l, g = jax.jit(lambda p: vag(p, ids, ids, rng=key))(params)
 
@@ -492,25 +491,14 @@ class TestOneFOneB:
             err = np.abs(a - b).max() / (np.abs(a).max() + 1e-8)
             assert err < 2e-4, (k, err)
 
-    def test_1f1b_dropout_without_rng_is_deterministic(self):
+    def test_1f1b_dropout_without_rng_is_deterministic(self, shared_1f1b_dropout):
         """No rng passed -> the schedule runs deterministic stages even for
         a dropout-configured model (eval semantics, old behavior)."""
-        import dataclasses
-
-        from accelerate_tpu.parallel.sharding import unbox_params
-
-        cfg = dataclasses.replace(
-            _cfg(num_layers=4), pipeline_stages=2, pipeline_microbatches=2,
-            pipeline_schedule="1f1b", dropout_rate=0.2, remat=False,
-            dtype=jnp.float32,
-        )
-        model = DecoderLM(cfg)
+        cfg, params, vag = shared_1f1b_dropout
         ids = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0, cfg.vocab_size)
-        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32))
-        params, _ = unbox_params(variables["params"])
-        vag = model.pipeline_value_and_grad()
-        l1, _ = jax.jit(vag)(params, ids, ids)
-        l2, _ = jax.jit(vag)(params, ids, ids)
+        jvag = jax.jit(vag)
+        l1, _ = jvag(params, ids, ids)
+        l2, _ = jvag(params, ids, ids)
         np.testing.assert_allclose(float(l1), float(l2), rtol=0)
 
     @pytest.mark.slow
