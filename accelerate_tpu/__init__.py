@@ -9,21 +9,38 @@ attention, and an `accelerate-tpu` CLI that launches one process per TPU host.
 
 __version__ = "0.1.0"
 
-from .state import AcceleratorState, GradientState, PartialState  # noqa: F401
-from .utils.dataclasses import (  # noqa: F401
-    DataLoaderConfiguration,
-    DistributedType,
-    GradientAccumulationPlugin,
-    ProjectConfiguration,
-    ShardingConfig,
-    ShardingStrategy,
+# Everything re-exported here resolves lazily through __getattr__ (PEP 562).
+# The state/dataclasses/logging trio used to be eager, which pulled
+# parallel.mesh + utils.{dataclasses,serialization,environment,...} into
+# EVERY process that merely names a config class — including the bench's
+# fresh-process TTFT workers, where the package-import chain is billed to
+# the proc_startup_imports phase of record.
+_LAZY_STATE = ("AcceleratorState", "GradientState", "PartialState")
+_LAZY_DATACLASSES = (
+    "DataLoaderConfiguration",
+    "DistributedType",
+    "GradientAccumulationPlugin",
+    "ProjectConfiguration",
+    "ShardingConfig",
+    "ShardingStrategy",
 )
-from .logging import get_logger  # noqa: F401
 
 
 def __getattr__(name):
     # Lazy heavy imports so `import accelerate_tpu` stays cheap
     # (reference keeps import time low too; tests/test_imports.py).
+    if name in _LAZY_STATE:
+        from . import state
+
+        return getattr(state, name)
+    if name in _LAZY_DATACLASSES:
+        from .utils import dataclasses as _dc
+
+        return getattr(_dc, name)
+    if name == "get_logger":
+        from .logging import get_logger
+
+        return get_logger
     if name == "Accelerator":
         from .accelerator import Accelerator
 
@@ -64,6 +81,17 @@ def __getattr__(name):
         from . import generation
 
         return getattr(generation, name)
+    if name in ("ServingEngine", "generate_batched"):
+        from . import serving
+
+        return getattr(serving, name)
+    if name == "roll_amax_histories":
+        # public for custom training loops that bypass TrainEngine: the
+        # delayed-fp8 scaling window only advances when this runs once per
+        # optimizer step (docs/fp8.md, "Delayed scaling")
+        from .ops.fp8 import roll_amax_histories
+
+        return roll_amax_histories
     if name in ("cpu_offload", "disk_offload", "cpu_offload_with_hook", "load_and_quantize_model"):
         from . import big_modeling
 

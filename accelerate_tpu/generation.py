@@ -178,6 +178,33 @@ def _fold_stage_into_data(mesh):
 _CACHE_BUCKET = 256
 
 
+def _sized_definition(definition, cache_len: int):
+    """Definition clone with ``max_cache_len = cache_len``, cached by
+    (id(definition), cache_len) so repeat calls return the SAME clone and
+    the jitted loops keyed on id(definition) re-hit. Shared by the
+    single-stream right-sizing below and the serving engine's arena sizing
+    (serving/engine.py), which needs an exact length, not a bucket."""
+    cfg = getattr(definition, "config", None)
+    if cfg is None or not hasattr(cfg, "max_cache_len"):
+        return definition
+    import dataclasses as _dc
+
+    key = (id(definition), cache_len)
+    hit = _SIZED_DEF_CACHE.get(key)
+    # the stored original pins it alive AND guards against id() reuse after
+    # an unrelated definition lands at the same address
+    if hit is not None and hit[0] is definition:
+        return hit[1]
+    try:
+        clone = definition.clone(config=_dc.replace(cfg, max_cache_len=cache_len))
+    except Exception:
+        return definition
+    if len(_SIZED_DEF_CACHE) >= _LOOP_CACHE_LIMIT:
+        _SIZED_DEF_CACHE.pop(next(iter(_SIZED_DEF_CACHE)))
+    _SIZED_DEF_CACHE[key] = (definition, clone)
+    return clone
+
+
 def _right_size_cache(definition, prompt_len: int, max_new_tokens: int):
     """Clone the definition with max_cache_len = prompt+budget rounded up to
     a 256 bucket. Decode attention cost scales with the cache length, so a
@@ -187,7 +214,6 @@ def _right_size_cache(definition, prompt_len: int, max_new_tokens: int):
     cfg = getattr(definition, "config", None)
     if cfg is None or not hasattr(cfg, "max_cache_len") or cfg.max_cache_len is not None:
         return definition
-    import dataclasses as _dc
 
     need = prompt_len + max_new_tokens
     sized = -(-need // _CACHE_BUCKET) * _CACHE_BUCKET
@@ -196,20 +222,7 @@ def _right_size_cache(definition, prompt_len: int, max_new_tokens: int):
         sized = min(sized, limit)
     if sized < need:
         return definition  # over max_seq_len; let the capacity check raise
-    key = (id(definition), sized)
-    hit = _SIZED_DEF_CACHE.get(key)
-    # the stored original pins it alive AND guards against id() reuse after
-    # an unrelated definition lands at the same address
-    if hit is not None and hit[0] is definition:
-        return hit[1]
-    try:
-        clone = definition.clone(config=_dc.replace(cfg, max_cache_len=sized))
-    except Exception:
-        return definition
-    if len(_SIZED_DEF_CACHE) >= _LOOP_CACHE_LIMIT:
-        _SIZED_DEF_CACHE.pop(next(iter(_SIZED_DEF_CACHE)))
-    _SIZED_DEF_CACHE[key] = (definition, clone)
-    return clone
+    return _sized_definition(definition, sized)
 
 
 def _cache_put(key, value):

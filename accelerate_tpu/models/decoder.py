@@ -150,6 +150,12 @@ class DecoderAttention(nn.Module):
     cache is [B, KVH, max_cache_len, D] — static shapes, so the whole decode
     loop compiles once.
 
+    ``cache_positions`` ([B] int32, decode-only) switches the cache to
+    slot-arena semantics (``serving/``): each batch row is an independent
+    request whose new K/V lands at its OWN offset and whose attention sees
+    only its own prefix — admission/eviction become pure data changes with
+    no shape change and no recompile.
+
     ``causal=False`` (+ optional ``kv_mask``) is the bidirectional form the
     seq2seq encoder reuses (models/seq2seq.py) — same projections, RoPE and
     logical axes, no cache. Ring attention over a "sequence" mesh axis is
@@ -163,7 +169,8 @@ class DecoderAttention(nn.Module):
     causal: bool = True
 
     @nn.compact
-    def __call__(self, x, sin, cos, deterministic: bool = True, kv_mask=None):
+    def __call__(self, x, sin, cos, deterministic: bool = True, kv_mask=None,
+                 cache_positions=None):
         cfg = self.config
         e, h, kv, d = cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
         b, s = x.shape[0], x.shape[1]
@@ -202,19 +209,39 @@ class DecoderAttention(nn.Module):
                 cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, 0, 0))
                 cache_index.value = jnp.asarray(s, jnp.int32)
                 out = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+            elif cache_positions is not None:
+                # slot-arena decode (serving/): every batch row writes its
+                # one new K/V at its own per-slot offset and attends only
+                # its own prefix. Stale entries past a slot's frontier
+                # (previous occupant, bucketed-prefill padding) are always
+                # overwritten at the write position BEFORE being attended,
+                # so slot reuse needs no cache clearing.
+                if s != 1:
+                    raise NotImplementedError(
+                        "cache_positions (slot-arena decode) expects one "
+                        "token per slot; chunked prefill runs per-slot via "
+                        "the scalar cache_index path"
+                    )
+                from ..ops.attention import decode_attention
+
+                rows = jnp.arange(b)
+                k_full = cached_k.value.at[rows, :, cache_positions].set(k[:, :, 0])
+                v_full = cached_v.value.at[rows, :, cache_positions].set(v[:, :, 0])
+                cached_k.value = k_full
+                cached_v.value = v_full
+                out = decode_attention(
+                    q, k_full, v_full, q_positions=cache_positions[:, None]
+                )
             else:
                 k_full = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
                 v_full = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
                 cached_k.value = k_full
                 cached_v.value = v_full
                 cache_index.value = cur + s
-                # query i sits at global position cur+i; valid kv = [0, cur+i]
-                q_pos = cur + jnp.arange(s)
-                kv_pos = jnp.arange(max_len)
-                from ..ops.attention import NEG_INF
+                from ..ops.attention import decode_attention
 
-                bias = jnp.where(kv_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF)[None, None]
-                out = dot_product_attention(q, k_full, v_full, causal=False, bias=bias)
+                # query i sits at global position cur+i; valid kv = [0, cur+i]
+                out = decode_attention(q, k_full, v_full, q_positions=cur + jnp.arange(s))
         elif (
             self.causal
             and kv_mask is None
@@ -268,12 +295,14 @@ class DecoderBlock(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, sin, cos, deterministic: bool = True):
+    def __call__(self, x, sin, cos, deterministic: bool = True, cache_positions=None):
         cfg = self.config
         ln1 = self.param("ln_attn", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         ln2 = self.param("ln_mlp", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         y = rms_norm(x, ln1, cfg.norm_eps)
-        y = DecoderAttention(cfg, self.mesh, self.use_cache, self.decode, name="attn")(y, sin, cos, deterministic)
+        y = DecoderAttention(cfg, self.mesh, self.use_cache, self.decode, name="attn")(
+            y, sin, cos, deterministic, cache_positions=cache_positions
+        )
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
         x = x + y
@@ -304,11 +333,13 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, aux, sin, cos = carry
+        # cpos rides the carry like sin/cos (a broadcast input every layer
+        # reads unchanged); None when the slot-arena path is off
+        x, aux, sin, cos, cpos = carry
         x, block_aux = DecoderBlock(self.config, self.mesh, self.use_cache, self.decode, name="block")(
-            x, sin, cos, self.deterministic
+            x, sin, cos, self.deterministic, cache_positions=cpos
         )
-        return (x, aux + block_aux, sin, cos), None
+        return (x, aux + block_aux, sin, cos, cpos), None
 
 
 class StageStack(nn.Module):
@@ -331,9 +362,9 @@ class StageStack(nn.Module):
             length=cfg.num_layers // cfg.pipeline_stages,
             metadata_params={nn.PARTITION_NAME: "layer"},
         )
-        (x, aux, _, _), _ = Stack(
+        (x, aux, _, _, _), _ = Stack(
             cfg, self.mesh, deterministic=deterministic, name="layers"
-        )((x, jnp.float32(0.0), sin, cos), None)
+        )((x, jnp.float32(0.0), sin, cos, None), None)
         if cfg.moe_num_experts > 1:
             # per-(stage, microbatch) router load-balance sum over this
             # stage's layers; the schedule accumulates and renormalizes
@@ -360,9 +391,15 @@ class DecoderLM(nn.Module):
         deterministic: bool = True,
         use_cache: bool = False,
         decode: bool = False,
+        cache_positions: Optional[jax.Array] = None,
     ):
         cfg = self.config
         b, s = input_ids.shape
+        if cache_positions is not None and not (use_cache and decode):
+            raise ValueError(
+                "cache_positions (slot-arena decode) requires use_cache=True "
+                "and decode=True"
+            )
         if use_cache and self._effective_stages() > 1:
             raise NotImplementedError(
                 "KV-cache decode through the GPipe schedule is not supported "
@@ -447,16 +484,16 @@ class DecoderLM(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layer"},
             )
-            (x, moe_aux, _, _), _ = ScanStack(
+            (x, moe_aux, _, _, _), _ = ScanStack(
                 cfg, self.mesh, use_cache, decode, deterministic, name="layers"
-            )((x, jnp.float32(0.0), sin, cos), None)
+            )((x, jnp.float32(0.0), sin, cos, cache_positions), None)
         else:
             block_cls = _maybe_streaming(DecoderBlock, cfg)
             if cfg.remat:
                 block_cls = nn.remat(block_cls, prevent_cse=True, policy=_remat_policy(cfg))
             for i in range(cfg.num_layers):
                 x, block_aux = block_cls(cfg, self.mesh, use_cache, decode, name=f"layer_{i}")(
-                    x, sin, cos, deterministic
+                    x, sin, cos, deterministic, cache_positions=cache_positions
                 )
                 moe_aux = moe_aux + block_aux
 

@@ -620,6 +620,44 @@ def flash_attention_bwd(
     )
 
 
+def decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_positions: jax.Array,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Masked KV-cache decode attention with per-row validity.
+
+    q: [B, H, Sq, D]; k/v: [B, KVH, L, D] — the full (static-length) cache
+    arena, already containing the query rows' own K/V. ``q_positions`` is
+    the GLOBAL position of each query row: shape [Sq] (shared across the
+    batch — the single-stream decode/chunked-prefill case) or [B, Sq]
+    (per-slot positions — the continuous-batching case, where every batch
+    row is an independent request at its own cache depth). A query attends
+    cache slot c iff ``c <= its position``, so per-slot cache lengths are
+    respected and slots beyond a request's frontier (stale garbage from a
+    previous occupant, padding from a bucketed prefill chunk) contribute
+    exactly zero probability.
+
+    Deliberately plain XLA: at Sq ∈ {1, chunk} the score matrix is tiny and
+    the cost is the HBM read of K/V (~1 flop/byte) — a pallas kernel cannot
+    beat the fused gather here, and routing every decode flavor through ONE
+    code path is what makes batched decode token-exact vs. the sequential
+    ``generate()`` loop.
+    """
+    kv_pos = jnp.arange(k.shape[2])
+    if q_positions.ndim == 1:  # [Sq] shared positions
+        bias = jnp.where(kv_pos[None, :] <= q_positions[:, None], 0.0, NEG_INF)
+        bias = bias[None, None]  # [1, 1, Sq, L]
+    else:  # [B, Sq] per-slot positions
+        bias = jnp.where(
+            kv_pos[None, None, :] <= q_positions[:, :, None], 0.0, NEG_INF
+        )[:, None]  # [B, 1, Sq, L]
+    return mha_reference(q, k, v, causal=False, sm_scale=sm_scale, bias=bias)
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,

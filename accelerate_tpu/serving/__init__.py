@@ -1,0 +1,5 @@
+"""Continuous-batching serving: slot-arena KV cache, chunked prefill
+admission, donated in-place batched decode (docs/serving.md)."""
+
+from .arena import arena_nbytes, arena_num_slots, init_arena  # noqa: F401
+from .engine import Request, ServingEngine, generate_batched  # noqa: F401

@@ -1,0 +1,625 @@
+"""Continuous-batching decode engine (the Orca/vLLM-style serving loop).
+
+``generation.generate()`` is one prompt -> one prefill -> one private
+decode loop; a server with N concurrent users would run N of those
+serially and waste (N-1)/N of every decode step's HBM bandwidth. This
+module decodes **many requests per device step** against one slot-arena
+KV cache and admits/evicts requests with no shape change, so a live
+engine never recompiles:
+
+- **slot-based batched KV cache** (``arena.py``) — the model's "cache"
+  collection at batch = num_slots, plus a per-slot ``lengths`` vector.
+  Admission writes a slot, eviction is host bookkeeping.
+- **fused batched decode step** — ONE jitted fn
+  ``(params, arena, last_tokens, lengths, active, rngs)`` with the arena
+  (and the per-slot state vectors) **donated**, so the multi-hundred-MB
+  cache updates in place instead of doubling HBM per step.
+- **chunked prefill admission** — new prompts prefill in fixed-size
+  bucketed chunks, one chunk per scheduler iteration, *interleaved*
+  between decode steps: a 10k-token prompt never stalls in-flight decodes
+  for more than one chunk's worth of compute.
+- **host-side scheduler** (``ServingEngine``) — request queue, slot
+  allocator, per-request token-stream callbacks, serving metrics through
+  the runtime telemetry pipeline.
+
+Token-exactness: batched decode reuses the exact sampling helpers and the
+exact masked-attention path (``ops/attention.decode_attention``) the
+single-stream loop uses, with per-request RNG chains split identically —
+so ``generate_batched()`` output is token-for-token equal to sequential
+``generate()`` calls with the same per-request seeds (tests/test_serving).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..generation import _sample, _sized_definition, depipeline
+from .arena import arena_nbytes, init_arena, slot_view, write_slot
+
+
+@dataclass
+class Request:
+    """One generation request and its life-cycle state. ``tokens`` is the
+    generated continuation (the prompt is not repeated); ``result()``
+    returns prompt + continuation like ``generate()`` does."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    rng: jax.Array
+    on_token: Optional[Callable] = None
+    id: int = -1
+
+    # runtime state (engine-owned)
+    tokens: list = field(default_factory=list)
+    done: bool = False
+    slot: Optional[int] = None
+    submit_t: float = 0.0
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    _last_token_t: float = 0.0
+
+    def result(self) -> np.ndarray:
+        """[prompt + generated] token ids (the ``generate()`` contract)."""
+        return np.concatenate([self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class ServingEngine:
+    """Slot-based continuous-batching scheduler over one decoder model.
+
+    ``temperature``/``top_k`` are engine-wide (they are *compiled into*
+    the fused decode step; per-request sampling params would either force
+    recompiles or a slower traced-sampling path). Per-request knobs are
+    the prompt, ``max_new_tokens``, the RNG seed, and the streaming
+    callback.
+
+    The decode step and every prefill-chunk bucket compile exactly once;
+    after ``mark_steady()`` the ``admission_recompiles`` property must
+    stay 0 no matter what prompt lengths arrive — the recompile invariant
+    the bench (`serving_admission_recompiles`) and tests assert.
+    """
+
+    def __init__(
+        self,
+        definition,
+        params,
+        *,
+        num_slots: int = 8,
+        max_cache_len: Optional[int] = None,
+        prefill_chunks=(64, 256),
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        eos_token_id: Optional[int] = None,
+        steps_per_call: int = 1,
+        param_placer=None,
+        donate: Optional[bool] = None,
+        telemetry=None,
+    ):
+        from ..utils.compile_cache import (
+            compile_event_counters,
+            ensure_persistent_compile_cache,
+            install_compile_listeners,
+        )
+
+        ensure_persistent_compile_cache()
+        install_compile_listeners()
+        definition, params = depipeline(definition, params)
+        cfg = getattr(definition, "config", None)
+        if cfg is None or not hasattr(cfg, "max_cache_len"):
+            raise ValueError(
+                "ServingEngine needs a definition with a DecoderConfig-style "
+                "config (max_cache_len/max_seq_len)"
+            )
+        cap = max_cache_len or cfg.max_cache_len or cfg.max_seq_len
+        if cap != cfg.max_cache_len:
+            definition = _sized_definition(definition, cap)
+        self.definition = definition
+        self.params = params
+        self.num_slots = int(num_slots)
+        self.max_cache_len = int(cap)
+        self.prefill_chunks = tuple(sorted(set(int(c) for c in prefill_chunks)))
+        if not self.prefill_chunks or self.prefill_chunks[0] < 1:
+            raise ValueError(f"bad prefill_chunks {prefill_chunks!r}")
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.eos_token_id = eos_token_id
+        # fuse up to K decode steps into one dispatch (a lax.scan of the
+        # SAME step body — bit-identical tokens): through a remote-attached
+        # runtime the per-dispatch host round trip otherwise dominates
+        # ms/token, the same reason build_train_step grew steps_per_call.
+        # Bursts only run when they cannot delay an admission or overshoot
+        # a request's budget, so scheduling behavior is unchanged.
+        self.steps_per_call = max(1, int(steps_per_call))
+        if param_placer is None:
+            from ..utils.quantization import dequantize_params as param_placer
+        self._placer = param_placer
+        # buffer donation: in-place arena updates on accelerator backends;
+        # CPU-sim runs keep it off (pre-0.6 jaxlibs warn-and-copy there)
+        self._donate = (
+            donate if donate is not None else jax.default_backend() != "cpu"
+        )
+
+        self._arena = init_arena(definition, params, self.num_slots, self._placer)
+        self.arena_bytes = arena_nbytes(self._arena)
+        self._tokens = jnp.zeros((self.num_slots,), jnp.int32)
+        self._lengths = jnp.zeros((self.num_slots,), jnp.int32)
+        self._rngs = jnp.zeros((self.num_slots, 2), jnp.uint32)
+        self._active = np.zeros((self.num_slots,), bool)
+
+        self._queue: deque = deque()
+        self._free = list(range(self.num_slots))[::-1]  # pop() -> slot 0 first
+        self._slot_req: dict = {}
+        self._admitting = None
+        # itertools.count is effectively atomic under the GIL — serve()
+        # advertises submit() from another thread
+        import itertools
+
+        self._next_id = itertools.count()
+
+        self._step_core = self._build_step_core()
+        donate = (1, 2, 3, 5) if self._donate else ()
+        self._decode_step = jax.jit(self._step_core, donate_argnums=donate)
+        self._decode_bursts: dict = {}
+        self._prefill_fns: dict = {}
+        self._admit_state = jax.jit(_admit_state_fn)
+
+        # metrics
+        self.step_count = 0
+        self.requests_completed = 0
+        self.generated_tokens = 0
+        self._step_samples: deque = deque(maxlen=512)  # (wall_s, tokens, steps)
+        self._itl: deque = deque(maxlen=2048)  # inter-token gaps, seconds
+        self._counters = compile_event_counters
+        self._steady_mark = None
+
+        if telemetry is None:
+            from ..telemetry import current_session
+
+            telemetry = current_session()
+        self.telemetry = telemetry
+        if telemetry is not None:
+            telemetry.attach_serving(self)
+
+    # -- compiled programs -------------------------------------------------
+
+    def _build_step_core(self):
+        definition, placer = self.definition, self._placer
+        temperature, top_k = self.temperature, self.top_k
+
+        last_pos = self.max_cache_len - 1
+
+        def step(params, arena, tokens, lengths, active, rngs):
+            """One batched decode step -> (arena, tokens, lengths, rngs).
+            Jitted directly as the single step and scanned by the bursts."""
+            # inactive slots still flow through the fused step (fixed batch)
+            # but must NOT write at ``lengths``: a slot mid-admission has
+            # prefill chunks landing in the arena while decode steps run
+            # interleaved, and a stray write there corrupts its prefix.
+            # Park them on the LAST cache position instead — any request
+            # that legitimately reaches it writes its own K/V there before
+            # attending, so the garbage is unreachable.
+            write_pos = jnp.where(active, lengths, last_pos)
+            out, mutated = definition.apply(
+                {"params": placer(params), "cache": arena},
+                tokens[:, None],
+                positions=write_pos[:, None],
+                use_cache=True,
+                decode=True,
+                cache_positions=write_pos,
+                mutable=["cache"],
+            )
+            logits = out["logits"][:, -1]  # [N, V]
+            split = jax.vmap(jax.random.split)(rngs)  # [N, 2, 2]
+            subs = split[:, 1]
+            # mirror the single-stream _sample call shape ([1, V] per slot)
+            # so the drawn bits — and therefore the tokens — are identical
+            nxt = jax.vmap(lambda key, row: _sample(row[None], key, temperature, top_k)[0])(
+                subs, logits
+            )
+            # frozen slots keep their token/length/rng: an inactive slot's
+            # RNG chain must not advance, or a request admitted mid-flight
+            # would diverge from its single-stream chain
+            nxt = jnp.where(active, nxt, tokens)
+            new_rngs = jnp.where(active[:, None], split[:, 0], rngs)
+            new_lengths = jnp.where(active, lengths + 1, lengths)
+            return mutated["cache"], nxt, new_lengths, new_rngs
+
+        return step
+
+    def _decode_burst(self, k: int):
+        """K fused decode steps in one dispatch: a lax.scan over the single
+        step body, so tokens are bit-identical to K separate steps. Returns
+        (arena, tokens, lengths, rngs, toks[K, N])."""
+        fn = self._decode_bursts.get(k)
+        if fn is not None:
+            return fn
+        core = self._step_core
+
+        def burst(params, arena, tokens, lengths, active, rngs):
+            def body(carry, _):
+                arena, tokens, lengths, rngs = carry
+                arena, tokens, lengths, rngs = core(
+                    params, arena, tokens, lengths, active, rngs
+                )
+                return (arena, tokens, lengths, rngs), tokens
+
+            (arena, tokens, lengths, rngs), toks = jax.lax.scan(
+                body, (arena, tokens, lengths, rngs), None, length=k
+            )
+            return arena, tokens, lengths, rngs, toks
+
+        fn = jax.jit(burst, donate_argnums=(1, 2, 3, 5) if self._donate else ())
+        self._decode_bursts[k] = fn
+        return fn
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        definition, placer = self.definition, self._placer
+        temperature, top_k = self.temperature, self.top_k
+
+        def prefill(params, arena, chunk_ids, slot, start, last_idx, rng):
+            # per-slot chunked prefill rides the scalar-cache_index decode
+            # path: queries at global positions start..start+C-1 attend the
+            # slot's full prefix — exact continuation across chunks
+            out, mutated = definition.apply(
+                {"params": placer(params), "cache": slot_view(arena, slot, start)},
+                chunk_ids,  # [1, C]
+                positions=start + jnp.arange(bucket),
+                use_cache=True,
+                decode=True,
+                mutable=["cache"],
+            )
+            arena = write_slot(arena, mutated["cache"], slot)
+            # first-token sample from the last VALID row (padding rows of a
+            # bucketed final chunk produce garbage logits we never read)
+            row = jax.lax.dynamic_index_in_dim(out["logits"][0], last_idx, 0, keepdims=False)
+            first = _sample(row[None], rng, temperature, top_k)[0]
+            return arena, first
+
+        fn = jax.jit(prefill, donate_argnums=(1,) if self._donate else ())
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def warmup(self):
+        """Compile every program this engine can ever dispatch — each
+        prefill bucket, the admission scatter, the single decode step and
+        the ``steps_per_call`` burst, plus the host-side eager RNG ops —
+        by running them once against the (idle) arena. After
+        ``warmup(); mark_steady()``, ``admission_recompiles`` staying 0 is
+        deterministic, not a function of what traffic happened to arrive.
+        All-inactive decode steps park their writes (see the step body), so
+        warmup leaves no observable state behind."""
+        if self._slot_req or self._queue or self._admitting is not None:
+            raise RuntimeError("warmup() needs an idle engine")
+        rng = jax.random.PRNGKey(0)
+        jax.random.split(rng)  # the eager per-admission ops
+        for bucket in self.prefill_chunks:
+            self._arena, _ = self._prefill_fn(bucket)(
+                self.params, self._arena, jnp.zeros((1, bucket), jnp.int32),
+                0, 0, bucket - 1, rng,
+            )
+        self._tokens, self._lengths, self._rngs = self._admit_state(
+            self._tokens, self._lengths, self._rngs, 0, 0, 0, rng
+        )
+        self._arena, self._tokens, self._lengths, self._rngs = self._decode_step(
+            self.params, self._arena, self._tokens, self._lengths, self._active,
+            self._rngs,
+        )
+        if self.steps_per_call > 1:
+            self._arena, self._tokens, self._lengths, self._rngs, _ = (
+                self._decode_burst(self.steps_per_call)(
+                    self.params, self._arena, self._tokens, self._lengths,
+                    self._active, self._rngs,
+                )
+            )
+        jax.device_get(self._tokens)
+        return self
+
+    # -- request API -------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int = 32,
+        seed: int = 0,
+        rng: Optional[jax.Array] = None,
+        on_token: Optional[Callable] = None,
+    ) -> Request:
+        """Queue one request; returns its live :class:`Request` handle.
+        ``rng``/``seed`` match ``generate(..., rng=...)``: the same seed
+        yields the same tokens the single-stream loop would produce.
+        ``on_token(token_id, request)`` fires as each token is emitted."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        cover = self._plan_cover(prompt.size)
+        if prompt.size + max_new_tokens > self.max_cache_len or cover > self.max_cache_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the slot KV capacity ({self.max_cache_len}); raise "
+                "max_cache_len"
+            )
+        req = Request(
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            rng=rng if rng is not None else jax.random.PRNGKey(seed),
+            on_token=on_token,
+            id=next(self._next_id),
+        )
+        req.submit_t = time.perf_counter()
+        self._queue.append(req)
+        return req
+
+    def generate_batched(self, prompts, *, max_new_tokens: int = 32, seeds=None):
+        """Submit ``prompts`` (list of 1-D id arrays), run to completion,
+        return the list of [prompt + generated] arrays — the batched
+        counterpart of N sequential ``generate()`` calls."""
+        if seeds is None:
+            seeds = range(len(prompts))
+        else:
+            seeds = list(seeds)
+            if len(seeds) != len(prompts):
+                raise ValueError(
+                    f"seeds ({len(seeds)}) must match prompts ({len(prompts)})"
+                )
+        reqs = [
+            self.submit(p, max_new_tokens=max_new_tokens, seed=s)
+            for p, s in zip(prompts, seeds)
+        ]
+        self.run()
+        return [r.result() for r in reqs]
+
+    # -- scheduler ---------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduler iteration: advance at most ONE prefill chunk, then
+        run one batched decode step over every active slot. Returns whether
+        any work happened (False = fully idle)."""
+        progressed = self._advance_admission()
+        progressed = self._decode_once() or progressed
+        return progressed
+
+    def run(self):
+        """Drive :meth:`step` until queue, admissions and slots are idle."""
+        while self._queue or self._admitting is not None or self._slot_req:
+            self.step()
+
+    def serve(self, should_stop: Optional[Callable[[], bool]] = None, idle_sleep_s: float = 0.001):
+        """Long-running loop: keep scheduling as requests arrive (from
+        callbacks or another thread's ``submit``) until ``should_stop()``
+        returns True; idle iterations sleep ``idle_sleep_s``."""
+        while should_stop is None or not should_stop():
+            if not self.step():
+                if should_stop is None:
+                    if not (self._queue or self._admitting or self._slot_req):
+                        return
+                time.sleep(idle_sleep_s)
+
+    # -- internals ---------------------------------------------------------
+
+    def _plan_chunks(self, prompt_len: int):
+        """(start, bucket) list covering [0, prompt_len) from the fixed
+        bucket set — largest bucket that fits, smallest (padded) for the
+        tail. A bounded bucket set means a bounded compile set: admission
+        at ANY prompt length reuses these programs."""
+        plan, start = [], 0
+        while start < prompt_len:
+            rem = prompt_len - start
+            fit = [c for c in self.prefill_chunks if c <= rem]
+            bucket = fit[-1] if fit else self.prefill_chunks[0]
+            plan.append((start, bucket))
+            start += bucket
+        return plan
+
+    def _plan_cover(self, prompt_len: int) -> int:
+        plan = self._plan_chunks(prompt_len)
+        start, bucket = plan[-1]
+        return start + bucket
+
+    def _advance_admission(self) -> bool:
+        if self._admitting is None:
+            if not self._queue or not self._free:
+                return False
+            req = self._queue.popleft()
+            slot = self._free.pop()
+            prefill_rng, decode_rng = jax.random.split(req.rng)
+            plan = self._plan_chunks(req.prompt.size)
+            self._admitting = [req, slot, plan, 0, prefill_rng, decode_rng]
+        req, slot, plan, idx, prefill_rng, decode_rng = self._admitting
+        start, bucket = plan[idx]
+        chunk = np.zeros((1, bucket), np.int32)
+        seg = req.prompt[start:start + bucket]
+        chunk[0, : seg.size] = seg
+        last_idx = min(req.prompt.size, start + bucket) - 1 - start
+        self._arena, first = self._prefill_fn(bucket)(
+            self.params, self._arena, jnp.asarray(chunk), slot, start, last_idx,
+            prefill_rng,
+        )
+        idx += 1
+        if idx < len(plan):
+            self._admitting[3] = idx
+            return True
+        # final chunk done -> the slot goes live with its first token
+        self._admitting = None
+        first_tok = int(jax.device_get(first))
+        length = int(req.prompt.size)
+        self._tokens, self._lengths, self._rngs = self._admit_state(
+            self._tokens, self._lengths, self._rngs, slot, first_tok, length,
+            decode_rng,
+        )
+        req.slot = slot
+        self._slot_req[slot] = req
+        self._active[slot] = True
+        now = time.perf_counter()
+        req.first_token_t = now
+        # _last_token_t stays 0.0 until _emit sets it: the first token has
+        # no preceding token, so it must not record a spurious 0.0 ITL gap
+        self._emit(req, first_tok, now)
+        return True
+
+    def _burst_len(self) -> int:
+        """steps_per_call when a fused burst cannot delay an admission or
+        overshoot any request's token budget, else 1. Only these two values
+        ever compile, keeping the program set bounded."""
+        k = self.steps_per_call
+        if k <= 1 or self._admitting is not None or (self._queue and self._free):
+            return 1
+        remaining = min(
+            req.max_new_tokens - len(req.tokens) for req in self._slot_req.values()
+        )
+        return k if remaining >= k else 1
+
+    def _decode_once(self) -> bool:
+        if not self._slot_req:
+            return False
+        k = self._burst_len()
+        t0 = time.perf_counter()
+        if k > 1:
+            self._arena, self._tokens, self._lengths, self._rngs, toks = (
+                self._decode_burst(k)(
+                    self.params, self._arena, self._tokens, self._lengths,
+                    self._active, self._rngs,
+                )
+            )
+            host = np.asarray(jax.device_get(toks))  # [K, N]; forces the burst
+        else:
+            self._arena, self._tokens, self._lengths, self._rngs = self._decode_step(
+                self.params, self._arena, self._tokens, self._lengths, self._active,
+                self._rngs,
+            )
+            host = np.asarray(jax.device_get(self._tokens))[None]  # [1, N]
+        now = time.perf_counter()
+        wall = now - t0
+        self.step_count += k
+        emitted = 0
+        for i in range(k):
+            for slot, req in list(self._slot_req.items()):
+                self._emit(req, int(host[i, slot]), now)
+                emitted += 1
+        # count DELIVERED tokens, not n_active*k: an eos finish mid-burst
+        # drops its slot's remaining burst tokens, and tokens/s must not
+        # claim them
+        self._step_samples.append((wall, emitted, k))
+        if self.telemetry is not None:
+            self.telemetry.on_step(self, wall, tokens=emitted, steps=k)
+        return True
+
+    def _emit(self, req: Request, token: int, now: float):
+        req.tokens.append(token)
+        self.generated_tokens += 1
+        if req._last_token_t:
+            self._itl.append(now - req._last_token_t)
+        req._last_token_t = now
+        if req.on_token is not None:
+            req.on_token(token, req)
+        if len(req.tokens) >= req.max_new_tokens or (
+            self.eos_token_id is not None and token == self.eos_token_id
+        ):
+            self._finish(req, now)
+
+    def _finish(self, req: Request, now: float):
+        req.done = True
+        req.finish_t = now
+        if req.slot is not None:
+            self._slot_req.pop(req.slot, None)
+            self._active[req.slot] = False
+            self._free.append(req.slot)
+            req.slot = None
+        self.requests_completed += 1
+
+    # -- metrics -----------------------------------------------------------
+
+    def mark_steady(self):
+        """Snapshot the compile counters: every compile AFTER this call
+        counts as an admission recompile (the invariant says there are
+        none). Call once the engine has seen each prefill bucket + the
+        decode step — e.g. after a warmup wave."""
+        self._steady_mark = self._counters()
+
+    @property
+    def admission_recompiles(self) -> Optional[int]:
+        """Backend compiles since :meth:`mark_steady` (None before it)."""
+        if self._steady_mark is None:
+            return None
+        return self._counters()["count"] - self._steady_mark["count"]
+
+    def metrics(self) -> dict:
+        """Serving gauges, ``serving/``-namespaced for the telemetry rollup
+        (TelemetrySession.attach_serving feeds these into every flush)."""
+        out = {
+            "serving/queue_depth": len(self._queue),
+            "serving/slot_occupancy": len(self._slot_req) / self.num_slots,
+            "serving/requests_completed": self.requests_completed,
+            "serving/generated_tokens": self.generated_tokens,
+            "serving/arena_bytes": self.arena_bytes,
+        }
+        if self._step_samples:
+            wall = sum(w for w, _, _ in self._step_samples)
+            toks = sum(n for _, n, _ in self._step_samples)
+            if wall > 0:
+                out["serving/tokens_per_s"] = toks / wall
+            out["serving/decode_step_ms_p50"] = 1e3 * float(
+                np.median([w / s for w, _, s in self._step_samples])
+            )
+        if self._itl:
+            itl = np.asarray(self._itl)
+            out["serving/itl_p50_ms"] = 1e3 * float(np.percentile(itl, 50))
+            out["serving/itl_p95_ms"] = 1e3 * float(np.percentile(itl, 95))
+        if self._steady_mark is not None:
+            out["serving/admission_recompiles"] = self.admission_recompiles
+        return out
+
+    @classmethod
+    def from_dispatched(cls, dispatched, **kwargs):
+        """Engine over a DispatchedModel (offloaded / quantized params +
+        its in-graph placement transform) — the serving counterpart of
+        ``generation.generate_dispatched``."""
+        params = dispatched._concrete(dispatched.params)
+        return cls(
+            dispatched.definition, params,
+            param_placer=dispatched.param_placer(), **kwargs,
+        )
+
+
+def _admit_state_fn(tokens, lengths, rngs, slot, first, length, rng):
+    """Scatter one slot's go-live state (traced ``slot``: one compile total,
+    not one per slot index)."""
+    return (
+        tokens.at[slot].set(first),
+        lengths.at[slot].set(length),
+        rngs.at[slot].set(rng),
+    )
+
+
+def generate_batched(
+    definition,
+    params,
+    prompts,
+    *,
+    max_new_tokens: int = 32,
+    num_slots: Optional[int] = None,
+    seeds=None,
+    **engine_kwargs,
+):
+    """One-shot batched generation: build a :class:`ServingEngine`, submit
+    every prompt, run to completion. Returns a list of [prompt + generated]
+    id arrays, token-exact vs. per-prompt ``generate()`` with the same
+    seeds. For a long-lived server keep an engine instead — this helper
+    rebuilds (and recompiles) per call."""
+    engine = ServingEngine(
+        definition, params,
+        num_slots=num_slots or min(max(len(prompts), 1), 8),
+        **engine_kwargs,
+    )
+    return engine.generate_batched(prompts, max_new_tokens=max_new_tokens, seeds=seeds)

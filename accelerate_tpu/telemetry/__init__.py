@@ -140,6 +140,7 @@ class TelemetrySession:
         self.trace_dir = self._resolve_trace_dir()
         self.window = MetricsWindow(config.window)
         self._engines: list = []
+        self._serving: list = []
         self._data_wait = 0.0
         self._pend_tokens = 0
         self._pend_samples = 0
@@ -236,6 +237,19 @@ class TelemetrySession:
                 )["bytes"])
             except Exception:
                 self._wire_bytes = None
+
+    def attach_serving(self, engine):
+        """Wire a serving engine (serving/engine.py): its ``serving/``
+        gauges — tokens/s, queue depth, slot occupancy, inter-token latency
+        percentiles, admission recompiles — join every rollup/flush, and
+        its decode steps feed the rolling window via ``on_step`` like a
+        train engine's do. Held by WEAK reference: a dropped engine (and
+        its multi-hundred-MB cache arena) must not be pinned for the
+        session's lifetime."""
+        import weakref
+
+        if not any(ref() is engine for ref in self._serving):
+            self._serving.append(weakref.ref(engine))
 
     # -- producers ---------------------------------------------------------
 
@@ -410,6 +424,15 @@ class TelemetrySession:
                 from .metrics import fp8_amax_health
 
                 out.update(fp8_amax_health(extra["fp8_stats"]))
+        self._serving = [ref for ref in self._serving if ref() is not None]
+        for ref in self._serving:
+            engine = ref()
+            if engine is None:
+                continue
+            try:
+                out.update(engine.metrics())
+            except Exception:  # a dying engine must not take the flush down
+                pass
         if self._wire_bytes is not None:
             out["sys/replica_wire_bytes_per_step"] = self._wire_bytes
         if self.config.device_memory:

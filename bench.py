@@ -547,6 +547,85 @@ def _decode_bench(cfg, prompt_len, base_tokens=16, extra_tokens=256):
     return float(np.median(timings))
 
 
+def _decode_batched_bench(cfg, prompt_len, batch_sizes=(8, 32), max_new=96,
+                          steps_per_call=16, warm_new=16):
+    """Continuous-batching decode throughput (serving/ServingEngine) on
+    device-resident bf16 weights: aggregate tokens/s and per-token latency
+    at each slot count, plus the recompile invariant of record.
+
+    Method: one warmup wave compiles every program (prefill buckets, the
+    single step, the burst), ``mark_steady()``, then a timed wave with
+    every slot occupied and STAGGERED prompt lengths — so the number also
+    witnesses that admissions at varying lengths trigger no new compiles
+    (``serving_admission_recompiles == 0``, asserted). Decode runs in
+    fused ``steps_per_call`` bursts, so per-token cost measures the chip,
+    not the tunnel round trip (same trick as the train benches' fused
+    loop). Tokens are forced to host every burst by the engine itself.
+    Returns {batch: {"tokens_per_sec", "ms_per_token", ...}, "recompiles"}.
+    """
+    import dataclasses
+
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving import ServingEngine
+
+    cap = -(-(2 * prompt_len + max_new) // 256) * 256
+    cfg = dataclasses.replace(cfg, max_cache_len=min(cfg.max_seq_len, cap))
+    model_def = DecoderLM(cfg)
+    variables = model_def.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len)
+    params, _ = unbox_params(variables["params"])
+    params = jax.device_put(
+        jax.tree_util.tree_map(lambda x: x.astype(cfg.dtype), params)
+    )
+    rng = np.random.RandomState(0)
+    out = {}
+    recompiles = {}
+    for n in batch_sizes:
+        engine = ServingEngine(
+            model_def, params, num_slots=n,
+            prefill_chunks=(prompt_len // 2, prompt_len),
+            steps_per_call=steps_per_call,
+        )
+        # warmup: deterministically compile every program (prefill buckets,
+        # admission scatter, single step, burst), then a tiny traffic wave
+        # for the remaining eager host paths, then freeze the compile set
+        engine.warmup()
+        warm = [rng.randint(0, cfg.vocab_size, (l,))
+                for l in (prompt_len, prompt_len // 2)]
+        engine.generate_batched(warm, max_new_tokens=warm_new)
+        engine.mark_steady()
+        engine._step_samples.clear()
+        engine._itl.clear()  # itl_p95 must measure the timed wave only
+        # timed wave: full occupancy, staggered prompt lengths
+        lengths = [prompt_len - (i % 4) * (prompt_len // 8) for i in range(n)]
+        prompts = [rng.randint(0, cfg.vocab_size, (l,)) for l in lengths]
+        t0 = time.perf_counter()
+        engine.generate_batched(prompts, max_new_tokens=max_new)
+        wall = time.perf_counter() - t0
+        m = engine.metrics()
+        rc = engine.admission_recompiles
+        recompiles[n] = rc
+        assert rc == 0, (
+            f"continuous-batching admissions recompiled {rc} programs at "
+            f"batch {n} — the slot arena's no-recompile invariant broke"
+        )
+        # decode-only rates from the engine's step samples (prefill chunks
+        # excluded); e2e_wall covers the whole wave incl. admissions.
+        # ms_per_token = mean device-step wall = each request's added
+        # latency per token, the apples-to-apples of decode_ms_per_token.
+        samples = list(engine._step_samples)
+        wall_d = sum(w for w, _, _ in samples)
+        toks = sum(t for _, t, _ in samples)
+        steps = sum(s for _, _, s in samples)
+        out[n] = {
+            "tokens_per_sec": round(toks / wall_d, 1) if wall_d else None,
+            "ms_per_token": round(1e3 * wall_d / steps, 3) if steps else None,
+            "itl_p95_ms": round(m.get("serving/itl_p95_ms", 0.0), 3),
+            "e2e_wall_s": round(wall, 2),
+        }
+    return out, recompiles
+
+
 def _pipeline_mem_worker():
     """Compiled temp-memory (stash + belts) for gpipe-under-AD vs the manual
     1F1B schedule at M=4S, on the 8-device CPU sim (the schedule's win is a
@@ -756,6 +835,22 @@ def main():
         extra["dispatch_ttft_int4_phases"] = matrix["int4"]["phases"]
         extra["decode_ms_per_token"] = round(_decode_bench(ttft_cfg, 128) * 1e3, 2)
 
+        # continuous-batching decode (serving/): the single-stream row
+        # above is the baseline this must beat ≥3x aggregate at batch 8
+        batched, rcs = _decode_batched_bench(ttft_cfg, 128, batch_sizes=(8, 32))
+        extra["decode_batched_tokens_per_sec"] = {
+            f"batch{n}": v["tokens_per_sec"] for n, v in batched.items()
+        }
+        extra["decode_batched_ms_per_token"] = {
+            f"batch{n}": v["ms_per_token"] for n, v in batched.items()
+        }
+        extra["decode_batched_detail"] = {f"batch{n}": v for n, v in batched.items()}
+        extra["serving_admission_recompiles"] = max(rcs.values())
+        single_tps = 1e3 / extra["decode_ms_per_token"]
+        extra["decode_batched_speedup_b8"] = round(
+            extra["decode_batched_tokens_per_sec"]["batch8"] / single_tps, 2
+        )
+
         # host-streamed row (VERDICT r5 missing #1: the flagship subsystem
         # proven with the host tier actually in the serving path): device
         # budget forced below the model, layer stack streams from pinned
@@ -806,6 +901,17 @@ def main():
         extra["decode_ms_per_token"] = round(
             _decode_bench(DecoderConfig.tiny(max_seq_len=128), 32, base_tokens=4, extra_tokens=16) * 1e3, 2
         )
+        batched, rcs = _decode_batched_bench(
+            DecoderConfig.tiny(max_seq_len=256), 32, batch_sizes=(8,),
+            max_new=24, steps_per_call=4, warm_new=5,
+        )
+        extra["decode_batched_tokens_per_sec"] = {
+            f"batch{n}": v["tokens_per_sec"] for n, v in batched.items()
+        }
+        extra["decode_batched_ms_per_token"] = {
+            f"batch{n}": v["ms_per_token"] for n, v in batched.items()
+        }
+        extra["serving_admission_recompiles"] = max(rcs.values())
 
     print(
         f"[bench] backend={jax.default_backend()} tokens/s={tok_s:,.0f} "

@@ -11,13 +11,24 @@ from accelerate_tpu.models import DecoderConfig, DecoderLM
 from accelerate_tpu.parallel.sharding import unbox_params
 
 
+# session-shared builds (same trick as test_pipeline's warm engines): the
+# un-jitted init costs ~0.7 s/model on the 1-core sim and, because the
+# jitted generate() loops key on id(definition), reusing the SAME model
+# object lets later tests skip the decode-loop retrace too. Params are jax
+# arrays (immutable) — tests can't corrupt each other through the share.
+_MODEL_CACHE: dict = {}
+
+
 def _model(**kw):
     kw.setdefault("max_seq_len", 64)
-    cfg = DecoderConfig.tiny(**kw)
-    model = DecoderLM(cfg)
-    variables = model.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
-    params, _ = unbox_params(variables["params"])
-    return model, cfg, params
+    key = tuple(sorted(kw.items()))
+    if key not in _MODEL_CACHE:
+        cfg = DecoderConfig.tiny(**kw)
+        model = DecoderLM(cfg)
+        variables = model.init_variables(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+        params, _ = unbox_params(variables["params"])
+        _MODEL_CACHE[key] = (model, cfg, params)
+    return _MODEL_CACHE[key]
 
 
 class TestKvCache:
@@ -34,7 +45,10 @@ class TestKvCache:
         # oracle via teacher forcing, ONE full uncached forward: greedy
         # decode is uniquely determined, so token i+1 must be the argmax of
         # the full-context logits at position i for every generated slot
-        full_logits = model.apply({"params": params}, out)["logits"]
+        # (jitted: the eager apply costs ~1 s of op dispatch on 1 core)
+        full_logits = jax.jit(
+            lambda p, ids: model.apply({"params": p}, ids)["logits"]
+        )(params, out)
         want = np.asarray(jnp.argmax(full_logits[:, 7:13], axis=-1))
         np.testing.assert_array_equal(np.asarray(out)[:, 8:14], want)
 
@@ -45,19 +59,21 @@ class TestKvCache:
         rng = np.random.RandomState(1)
         ids = jnp.asarray(rng.randint(3, cfg.vocab_size, (1, 12)))
 
-        # full forward
-        full_logits = model.apply({"params": params}, ids)["logits"]
+        # full forward, prefill, and one decode step — each jitted (the
+        # three eager applies previously cost ~3 s of op dispatch on 1 core)
+        full_logits = jax.jit(
+            lambda p, i: model.apply({"params": p}, i)["logits"]
+        )(params, ids)
 
         # prefill on the first 11, decode the 12th
-        out, mutated = model.apply(
-            {"params": params}, ids[:, :11], positions=jnp.arange(11),
+        out, mutated = jax.jit(lambda p, i: model.apply(
+            {"params": p}, i, positions=jnp.arange(11),
             use_cache=True, mutable=["cache"],
-        )
-        step_out, _ = model.apply(
-            {"params": params, "cache": mutated["cache"]},
-            ids[:, 11:12], positions=jnp.asarray([11]),
+        ))(params, ids[:, :11])
+        step_out, _ = jax.jit(lambda p, c, i: model.apply(
+            {"params": p, "cache": c}, i, positions=jnp.asarray([11]),
             use_cache=True, decode=True, mutable=["cache"],
-        )
+        ))(params, mutated["cache"], ids[:, 11:12])
         np.testing.assert_allclose(
             np.asarray(step_out["logits"][:, -1]),
             np.asarray(full_logits[:, -1]),

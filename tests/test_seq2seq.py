@@ -12,12 +12,23 @@ from accelerate_tpu.models.seq2seq import shift_right
 from accelerate_tpu.parallel.sharding import unbox_params
 
 
+# session-shared builds (same trick as test_pipeline's warm engines): each
+# un-jitted seq2seq init costs seconds on the 1-core sim, and several tests
+# ask for identical configs. Params are immutable jax arrays; the echo test
+# trains on a rebound copy, never the shared tree.
+_MODEL_CACHE: dict = {}
+
+
 def _model_and_params(rng_seed=0, **kw):
-    cfg = Seq2SeqConfig.tiny(**kw)
-    model = Seq2SeqLM(cfg)
-    v = model.init_variables(jax.random.PRNGKey(rng_seed), batch_size=2, seq_len=16, target_len=12)
-    params, _ = unbox_params(v["params"])
-    return model, cfg, params
+    key = (rng_seed, tuple(sorted(kw.items())))
+    if key not in _MODEL_CACHE:
+        cfg = Seq2SeqConfig.tiny(**kw)
+        model = Seq2SeqLM(cfg)
+        v = model.init_variables(jax.random.PRNGKey(rng_seed), batch_size=2,
+                                 seq_len=16, target_len=12)
+        params, _ = unbox_params(v["params"])
+        _MODEL_CACHE[key] = (model, cfg, params)
+    return _MODEL_CACHE[key]
 
 
 class TestShiftRight:
@@ -48,28 +59,32 @@ class TestSeq2SeqTraining:
         mask = np.ones((2, 16), np.int32)
         mask[:, 10:] = 0
 
-        auto = model.apply({"params": params}, jnp.asarray(src), labels=tgt,
-                           attention_mask=jnp.asarray(mask))["loss"]
-        explicit = model.apply(
-            {"params": params}, jnp.asarray(src),
+        # jitted apply wrappers: op-by-op eager dispatch of these
+        # reference computations costs ~1 s each on the 1-core sim, while
+        # the compiled forms land in the persistent test cache once
+        loss_auto = jax.jit(lambda s, m: model.apply(
+            {"params": params}, s, labels=tgt, attention_mask=m)["loss"])
+        loss_explicit = jax.jit(lambda s, m: model.apply(
+            {"params": params}, s,
             decoder_input_ids=shift_right(tgt, cfg.decoder_start_token_id),
-            labels=tgt, attention_mask=jnp.asarray(mask),
-        )["loss"]
+            labels=tgt, attention_mask=m)["loss"])
+        logits_fn = jax.jit(lambda s, m: model.apply(
+            {"params": params}, s,
+            decoder_input_ids=shift_right(tgt, cfg.decoder_start_token_id),
+            attention_mask=m)["logits"])
+
+        auto = loss_auto(jnp.asarray(src), jnp.asarray(mask))
+        explicit = loss_explicit(jnp.asarray(src), jnp.asarray(mask))
         np.testing.assert_allclose(float(auto), float(explicit), rtol=1e-6)
 
-        logits = model.apply(
-            {"params": params}, jnp.asarray(src),
-            decoder_input_ids=shift_right(tgt, cfg.decoder_start_token_id),
-            attention_mask=jnp.asarray(mask),
-        )["logits"]
+        logits = logits_fn(jnp.asarray(src), jnp.asarray(mask))
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         picked = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
         np.testing.assert_allclose(float(auto), float(jnp.mean(lse - picked)), rtol=1e-5)
 
         src2 = src.copy()
         src2[:, 10:] = rng.randint(3, cfg.vocab_size, (2, 6))
-        masked2 = model.apply({"params": params}, jnp.asarray(src2), labels=tgt,
-                              attention_mask=jnp.asarray(mask))["loss"]
+        masked2 = loss_auto(jnp.asarray(src2), jnp.asarray(mask))
         np.testing.assert_allclose(float(auto), float(masked2), rtol=1e-6)
 
     def test_echo_task_trains_through_cross_attention(self):
@@ -119,12 +134,16 @@ class TestSeq2SeqGeneration:
         toks = generate_seq2seq(model, params, src, max_new_tokens=2, attention_mask=mask)
         assert toks.shape == (2, 2)
 
-        enc = model.apply({"params": params}, src, mask, method="encode")
+        # jitted reference (one program per grown decoder length — both land
+        # in the persistent cache; eager applies cost ~1 s each on 1 core)
+        encode = jax.jit(lambda s, m: model.apply({"params": params}, s, m, method="encode"))
+        decode = jax.jit(lambda d, e, m: model.apply(
+            {"params": params}, d, encoder_states=e, attention_mask=m, method="decode"))
+        enc = encode(src, mask)
         dec_in = jnp.full((2, 1), cfg.decoder_start_token_id, jnp.int32)
         ref = []
         for _ in range(2):
-            logits = model.apply({"params": params}, dec_in, encoder_states=enc,
-                                 attention_mask=mask, method="decode")
+            logits = decode(dec_in, enc, mask)
             nxt = jnp.argmax(logits[:, -1], axis=-1)
             ref.append(nxt)
             dec_in = jnp.concatenate([dec_in, nxt[:, None].astype(jnp.int32)], axis=1)
