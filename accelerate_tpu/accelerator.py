@@ -2048,6 +2048,21 @@ class Accelerator:
             self.log(values, step=step, log_kwargs=log_kwargs)
         return values
 
+    def prometheus_metrics(self) -> str:
+        """The live telemetry rollup + SLO histograms as Prometheus text
+        exposition — what the scrape thread serves
+        (``TelemetryConfig(exporter_port=...)`` / ``ATT_TELEMETRY_PORT``);
+        exposed directly for custom health endpoints. Requires
+        ``telemetry=`` to be enabled."""
+        if self.telemetry is None:
+            raise RuntimeError(
+                "telemetry is not enabled; pass telemetry=TelemetryConfig(...) "
+                "(or True) to Accelerator, or set ATT_TELEMETRY=1."
+            )
+        from .telemetry.exporter import prometheus_text
+
+        return prometheus_text(self.telemetry)
+
     def end_training(self):
         if self.telemetry is not None:
             self.telemetry.close()

@@ -1,0 +1,220 @@
+"""`accelerate-tpu trace` — inspect the telemetry dir's serving artifacts.
+
+A multi-host run leaves one Chrome-trace span JSONL and one request-log
+JSONL per host in its telemetry dir; this command turns them back into
+answers without a notebook:
+
+    accelerate-tpu trace merge runs/exp/telemetry -o merged.json
+    accelerate-tpu trace merge runs/exp/telemetry --request-id 42
+    accelerate-tpu trace summary runs/exp/telemetry
+    accelerate-tpu trace summary runs/exp/telemetry --request-id 42 --json
+
+``merge`` folds every host's span stream into ONE Perfetto-loadable
+Chrome trace (hosts stay separate rows via their pid; per-host clock
+epochs are aligned through the ``epoch_unix_s`` metadata each recorder
+writes), optionally filtered to the spans of a single request.
+``summary`` renders the request-log JSONL as a latency table — one row
+per request plus aggregate TTFT/ITL/queue-wait percentiles from the same
+log-bucketed histograms the live session uses — or, with
+``--request-id``, the full lifecycle of one request (prefill chunk plan,
+ITL series, compile activity). Pure stdlib + the telemetry host modules:
+no jax import, so it runs anywhere the log files land.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def _span_files(target: str) -> list:
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "trace-host*.jsonl")))
+    return [target]
+
+
+def _request_files(target: str) -> list:
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "requests-host*.jsonl")))
+    return [target]
+
+
+def merge_traces(target: str, request_id=None) -> dict:
+    """Merge per-host span JSONLs into one ``{"traceEvents": [...]}``.
+
+    Each recorder rebases its ``ts`` clock to its own start; the
+    ``process_name`` metadata line carries ``epoch_unix_s``, so hosts are
+    shifted onto the earliest host's axis before merging. With
+    ``request_id``, only that request's spans (events whose args carry the
+    id) plus the metadata rows survive."""
+    per_host = []
+    for path in _span_files(target):
+        events = []
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+        epoch = None
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                epoch = (e.get("args") or {}).get("epoch_unix_s")
+                break
+        per_host.append((epoch, events))
+    if not per_host:
+        return {"traceEvents": []}
+    epochs = [ep for ep, _ in per_host if ep is not None]
+    base = min(epochs) if epochs else None
+    merged = []
+    for epoch, events in per_host:
+        shift_us = (epoch - base) * 1e6 if (epoch is not None and base is not None) else 0.0
+        for e in events:
+            if e.get("ph") == "M":
+                merged.append(e)
+                continue
+            if request_id is not None:
+                if (e.get("args") or {}).get("request_id") != request_id:
+                    continue
+            if shift_us and "ts" in e:
+                e = dict(e, ts=round(e["ts"] + shift_us, 3))
+            merged.append(e)
+    merged.sort(key=lambda e: (e.get("ph") == "M" and -1) or e.get("ts", 0))
+    return {"traceEvents": merged}
+
+
+def load_requests(target: str) -> list:
+    """Every request record in the dir/file, tagged with its source host."""
+    out = []
+    for path in _request_files(target):
+        name = os.path.basename(path)
+        host = name[len("requests-host"):-len(".jsonl")] if name.startswith("requests-host") else "?"
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    rec = json.loads(line)
+                    rec.setdefault("host", host)
+                    out.append(rec)
+    out.sort(key=lambda r: (r.get("submit_unix_s", 0), r.get("request_id", 0)))
+    return out
+
+
+def summarize_requests(records: list) -> dict:
+    """Aggregate latency stats over request records — the same
+    ``StreamingHistogram`` percentiles the live session reports."""
+    from ..telemetry.histograms import StreamingHistogram
+
+    hists = {"queue_wait_ms": StreamingHistogram(), "ttft_ms": StreamingHistogram(),
+             "total_ms": StreamingHistogram(), "itl_ms": StreamingHistogram()}
+    tokens = 0
+    reasons: dict = {}
+    for rec in records:
+        for key in ("queue_wait_ms", "ttft_ms", "total_ms"):
+            v = rec.get(key)
+            if isinstance(v, (int, float)):
+                hists[key].add(v / 1e3)
+        for v in rec.get("itl_ms") or []:
+            hists["itl_ms"].add(v / 1e3)
+        tokens += rec.get("tokens") or 0
+        reason = rec.get("finish_reason", "?")
+        reasons[reason] = reasons.get(reason, 0) + 1
+    agg = {"requests": len(records), "tokens": tokens, "finish_reasons": reasons}
+    for key, hist in hists.items():
+        snap = hist.snapshot()
+        if snap:
+            agg[f"{key[:-3]}_p50_ms"] = round(snap["p50_s"] * 1e3, 3)
+            agg[f"{key[:-3]}_p95_ms"] = round(snap["p95_s"] * 1e3, 3)
+            agg[f"{key[:-3]}_p99_ms"] = round(snap["p99_s"] * 1e3, 3)
+    return agg
+
+
+def _format_table(records: list, agg: dict) -> str:
+    cols = ("id", "host", "slot", "prompt", "tokens", "queue_ms", "ttft_ms",
+            "itl_p50_ms", "total_ms", "reason")
+    rows = [cols]
+    for rec in records:
+        rows.append((
+            str(rec.get("request_id")), str(rec.get("host", "?")),
+            str(rec.get("slot")), str(rec.get("prompt_len")),
+            str(rec.get("tokens")), str(rec.get("queue_wait_ms", "")),
+            str(rec.get("ttft_ms", "")), str(rec.get("itl_p50_ms", "")),
+            str(rec.get("total_ms", "")), str(rec.get("finish_reason", "")),
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
+    lines = ["  ".join(c.rjust(w) for c, w in zip(row, widths)) for row in rows]
+    lines.append("")
+    lines.append(
+        f"{agg['requests']} requests, {agg['tokens']} tokens; "
+        + ", ".join(
+            f"{k[:-len('_p50_ms')]} p50/p95/p99 = "
+            f"{agg[k]}/{agg[k.replace('p50', 'p95')]}/{agg[k.replace('p50', 'p99')]} ms"
+            for k in ("queue_wait_p50_ms", "ttft_p50_ms", "itl_p50_ms")
+            if k in agg
+        )
+    )
+    return "\n".join(lines)
+
+
+def trace_command(args) -> int:
+    if args.trace_cmd == "merge":
+        trace = merge_traces(args.target, request_id=args.request_id)
+        spans = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+        if not spans:
+            what = (f"no spans for request id {args.request_id}"
+                    if args.request_id is not None else "no span events")
+            print(f"{what} found under {args.target}", file=sys.stderr)
+            return 1
+        body = json.dumps(trace)
+        if args.output:
+            with open(args.output, "w") as fh:
+                fh.write(body)
+            n = len(trace["traceEvents"])
+            print(f"wrote {n} events -> {args.output} (load in Perfetto / chrome://tracing)")
+        else:
+            print(body)
+        return 0
+    if args.trace_cmd == "summary":
+        records = load_requests(args.target)
+        if not records:
+            print(f"no request records found under {args.target}", file=sys.stderr)
+            return 1
+        if args.request_id is not None:
+            records = [r for r in records if r.get("request_id") == args.request_id]
+            if not records:
+                print(f"request id {args.request_id} not in the log", file=sys.stderr)
+                return 1
+            print(json.dumps(records if len(records) > 1 else records[0], indent=2))
+            return 0
+        agg = summarize_requests(records)
+        if args.json:
+            print(json.dumps({"requests": records, "aggregate": agg}))
+        else:
+            print(_format_table(records, agg))
+        return 0
+    print("usage: accelerate-tpu trace {merge,summary} ...", file=sys.stderr)
+    return 1
+
+
+def register(subparsers):
+    parser = subparsers.add_parser(
+        "trace", help="Merge / inspect telemetry span traces and request logs"
+    )
+    sub = parser.add_subparsers(dest="trace_cmd")
+    merge = sub.add_parser(
+        "merge", help="Merge per-host Chrome-trace JSONLs into one trace JSON"
+    )
+    merge.add_argument("target", help="telemetry dir (or one trace-host*.jsonl)")
+    merge.add_argument("-o", "--output", default=None, help="output path (default: stdout)")
+    merge.add_argument("--request-id", type=int, default=None,
+                       help="keep only this request's spans")
+    summary = sub.add_parser(
+        "summary", help="Summarize request-log JSONL(s) into a latency table"
+    )
+    summary.add_argument("target", help="telemetry dir (or one requests-host*.jsonl)")
+    summary.add_argument("--request-id", type=int, default=None,
+                         help="print one request's full lifecycle record")
+    summary.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.set_defaults(func=trace_command)
+    return parser

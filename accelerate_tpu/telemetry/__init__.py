@@ -28,6 +28,16 @@ runtime together and the engine feeds it automatically:
   the same rails for the TTFT path).
 - **watchdog** — ``telemetry.watchdog`` monitors a shared-dict heartbeat
   and dumps per-host stacks + the last spans when a step stalls.
+- **request tracing** — ``telemetry.requests`` records every serving
+  request's lifecycle (queue wait → prefill chunks → per-token ITL →
+  finish) as spans + one JSONL record per request, feeding the
+  **SLO histograms** (``telemetry.histograms``) whose TTFT/ITL/queue-wait
+  p50/p95/p99 ride every rollup and the Prometheus exposition
+  (``telemetry.exporter``, optional scrape thread).
+- **flight recorder** — ``telemetry.recorder`` keeps a bounded ring of
+  recent events and dumps a debug bundle (in-flight requests, spans,
+  memory, stacks) on unhandled exception, watchdog trip, or SIGTERM;
+  trigger-based ``jax.profiler`` capture windows ride the same module.
 
 Everything is off unless a config is passed (or ``ATT_TELEMETRY=1``);
 when off, the engine's only cost is one ``is None`` check per step.
@@ -40,6 +50,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from .histograms import StreamingHistogram, percentile_keys  # noqa: F401
 from .metrics import MetricsWindow, batch_token_count, flops_per_token_fn
 from .spans import SpanRecorder, load_chrome_trace, span  # noqa: F401 (public API)
 from .watchdog import HeartbeatWatchdog, build_stall_report  # noqa: F401
@@ -85,12 +96,28 @@ class TelemetryConfig:
     watchdog_deadline_s: float = 300.0
     watchdog_poll_s: Optional[float] = None
     heartbeat_dir: Optional[str] = None    # shared dir for cross-host straggler naming
+    # request-level tracing + SLO histograms (serving; docs/serving.md)
+    request_log: bool = True               # per-request JSONL records (needs trace_dir)
+    token_span_every: int = 0              # per-token decode spans for 1-in-N requests (0 = off)
+    itl_series_max: int = 512              # ITL samples kept per request record
+    exporter_port: Optional[int] = None    # Prometheus scrape thread (0 = ephemeral port)
+    # flight recorder (docs/troubleshooting.md)
+    flight_recorder: bool = True
+    flight_events: int = 256               # bounded event ring capacity
+    flight_hooks: bool = True              # dump on sys.excepthook / SIGTERM
+    # trigger-based jax.profiler capture windows (docs/profiling.md)
+    profile_steps: Optional[tuple] = None  # (start, stop) step window
+    profile_window_steps: int = 16         # auto-armed window length, in steps
+    profile_trigger_itl_p99_ms: Optional[float] = None  # SLO breach auto-arm
+    profile_dir: Optional[str] = None      # default: <trace_dir>/profile
 
     @classmethod
     def from_env(cls) -> Optional["TelemetryConfig"]:
         """ATT_TELEMETRY=1 enables defaults; ATT_TELEMETRY_DIR sets
         trace_dir; ATT_TELEMETRY_WATCHDOG_S enables the watchdog with that
-        deadline. Returns None when the env asks for nothing."""
+        deadline; ATT_TELEMETRY_PORT starts the Prometheus scrape thread;
+        ATT_TELEMETRY_PROFILE_STEPS="N:M" arms a capture window for steps
+        N..M. Returns None when the env asks for nothing."""
         flag = os.environ.get("ATT_TELEMETRY", "").strip().lower()
         wd = os.environ.get("ATT_TELEMETRY_WATCHDOG_S", "").strip()
         if flag in ("", "0", "false") and not wd:
@@ -102,6 +129,29 @@ class TelemetryConfig:
         if wd:
             cfg.watchdog = True
             cfg.watchdog_deadline_s = float(wd)
+        port = os.environ.get("ATT_TELEMETRY_PORT", "").strip()
+        if port:
+            try:
+                cfg.exporter_port = int(port)
+            except ValueError:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "ignoring malformed ATT_TELEMETRY_PORT=%r (expected an "
+                    "integer port; 0 = ephemeral)", port,
+                )
+        win = os.environ.get("ATT_TELEMETRY_PROFILE_STEPS", "").strip()
+        if win:
+            lo, _, hi = win.partition(":")
+            try:
+                cfg.profile_steps = (int(lo), int(hi))
+            except ValueError:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "ignoring malformed ATT_TELEMETRY_PROFILE_STEPS=%r "
+                    "(expected N:M, e.g. 100:120)", win,
+                )
         return cfg
 
 
@@ -146,6 +196,7 @@ class TelemetrySession:
         self._pend_samples = 0
         self._pend_seq_len = None
         self._last_opt_t: Optional[float] = None
+        self._last_beat = None
         self._last_hb_file_t = 0.0
         self._flops_fn = None
         self._wire_bytes: Optional[int] = None
@@ -179,6 +230,51 @@ class TelemetrySession:
         install_compile_listeners()
         self._compile_mark = compile_event_counters()
 
+        # SLO histograms + the request tracer (serving engines feed both)
+        self.hists: dict = {}
+        from .requests import RequestTracer
+
+        req_path = None
+        if config.request_log and self.trace_dir:
+            req_path = os.path.join(
+                self.trace_dir, f"requests-host{self.process_index}.jsonl"
+            )
+        self.requests = RequestTracer(
+            self, req_path, itl_series_max=config.itl_series_max,
+            token_span_every=config.token_span_every,
+        )
+
+        self.flight = None
+        if config.flight_recorder:
+            from .recorder import FlightRecorder
+
+            self.flight = FlightRecorder(
+                self, dump_dir=self.trace_dir, capacity=config.flight_events,
+                process_index=self.process_index,
+            )
+            if config.flight_hooks:
+                self.flight.install_hooks()
+
+        self.capture = None
+        if config.profile_steps or config.profile_trigger_itl_p99_ms is not None:
+            pdir = config.profile_dir or (
+                os.path.join(self.trace_dir, "profile") if self.trace_dir else None
+            )
+            if pdir:
+                from .recorder import CaptureWindow
+
+                start, stop = config.profile_steps or (None, None)
+                self.capture = CaptureWindow(
+                    pdir, start_step=start, stop_step=stop,
+                    window_steps=config.profile_window_steps,
+                )
+
+        self.exporter = None
+        if config.exporter_port is not None:
+            from .exporter import ScrapeServer
+
+            self.exporter = ScrapeServer(self, port=config.exporter_port)
+
         self.watchdog: Optional[HeartbeatWatchdog] = None
         if config.watchdog:
             self.watchdog = HeartbeatWatchdog(
@@ -187,6 +283,7 @@ class TelemetrySession:
                 heartbeat_dir=config.heartbeat_dir,
                 dump_dir=self.trace_dir,
                 last_spans=config.span_ring,
+                on_stall=self._on_stall,
             ).start()
 
         _ACTIVE_SESSION = self
@@ -250,6 +347,43 @@ class TelemetrySession:
 
         if not any(ref() is engine for ref in self._serving):
             self._serving.append(weakref.ref(engine))
+
+    def histogram(self, name: str) -> StreamingHistogram:
+        """Get-or-create the named SLO histogram (e.g. ``serving/ttft``;
+        values in seconds). Percentiles join every rollup as
+        ``{name}_p50_ms``/``_p95_ms``/``_p99_ms`` and the Prometheus
+        exposition as a native histogram."""
+        h = self.hists.get(name)
+        if h is None:
+            h = self.hists[name] = StreamingHistogram()
+        return h
+
+    def _on_stall(self, report: str):
+        """Watchdog trip: dump a flight-recorder bundle and (when a
+        profiler window is configured) arm a capture for the next steps."""
+        if self.flight is not None:
+            self.flight.note("watchdog_stall")
+            self.flight.dump("watchdog_stall", extra={"stall_report": report})
+        if self.capture is not None:
+            self.capture.arm("watchdog_stall")
+
+    def executable_memory(self) -> dict:
+        """Live-executable ``memory_analysis`` from every attached serving
+        engine (flight-recorder bundle section); {} when none exposes it.
+        Cached-only: this runs on the watchdog thread against a possibly
+        wedged backend, so it must never trigger a compile."""
+        out = {}
+        for ref in list(self._serving):
+            engine = ref()
+            if engine is None:
+                continue
+            try:
+                stats = engine.executable_memory_stats(cached_only=True)
+            except Exception:
+                continue
+            if stats:
+                out[f"serving_engine_{len(out)}"] = stats
+        return out
 
     # -- producers ---------------------------------------------------------
 
@@ -320,6 +454,19 @@ class TelemetrySession:
                                cat="engine", args={"step": step, "steps": steps})
         if self._metrics_fh is not None:
             self._write_step_record(rec)
+        if self.flight is not None:
+            self.flight.note("step", step=step, steps=steps,
+                             wall_ms=round(wall_s * 1e3, 2), tokens=tokens)
+        if self.capture is not None:
+            thr = self.config.profile_trigger_itl_p99_ms
+            if thr is not None and not self.capture.active:
+                itl = self.hists.get("serving/itl")
+                # a few samples must accrue before a p99 means anything
+                if itl is not None and itl.count >= 16:
+                    p99 = itl.quantile(0.99)
+                    if p99 is not None and p99 * 1e3 > thr:
+                        self.capture.arm("itl_p99_slo")
+            self.capture.on_step(step)
         fe = self.config.flush_every
         if fe and len(self.window.records) and self.window.total_steps % fe == 0:
             self.flush(step=step)
@@ -327,6 +474,9 @@ class TelemetrySession:
     def _heartbeat(self, step: int):
         from ..state import PartialState
 
+        # session-local beat: a serving-only process never constructs
+        # PartialState, and the watchdog must still see progress there
+        self._last_beat = (int(step), time.monotonic())
         if PartialState._shared_state:
             PartialState().publish_heartbeat(step)
         if self.config.heartbeat_dir:
@@ -424,6 +574,14 @@ class TelemetrySession:
                 from .metrics import fp8_amax_health
 
                 out.update(fp8_amax_health(extra["fp8_stats"]))
+        # lifetime SLO histograms first, then the serving-engine gauges:
+        # where the keys overlap (serving/itl_p50/_p95_ms) the engine's
+        # RECENT-window view must win, or a fresh latency regression would
+        # be diluted by hours of healthy lifetime traffic; the histograms
+        # keep exclusive ownership of _p99/_count/_mean/_max and the
+        # ttft/queue_wait families
+        for name, hist in list(self.hists.items()):
+            out.update(percentile_keys(name, hist))
         self._serving = [ref for ref in self._serving if ref() is not None]
         for ref in self._serving:
             engine = ref()
@@ -441,6 +599,29 @@ class TelemetrySession:
             out.update(device_memory_stats())
         return out
 
+    def host_rollup(self) -> dict:
+        """``rollup()`` minus every device interaction: no ``device_get``
+        of pending loss/grad scalars, no peak-flops probe, no memory
+        query. This is what the flight recorder snapshots from the
+        watchdog thread — a full rollup would block forever on the very
+        wedged backend the dump is diagnosing."""
+        out = self.window.rollup(peak=self._peak)
+        last = self.window.last()
+        if last is not None:
+            out["sys/step"] = last["step"]
+        for name, hist in list(self.hists.items()):
+            out.update(percentile_keys(name, hist))
+        self._serving = [ref for ref in self._serving if ref() is not None]
+        for ref in self._serving:
+            engine = ref()
+            if engine is None:
+                continue
+            try:
+                out.update(engine.metrics())  # host-side deque/counter math
+            except Exception:
+                pass
+        return out
+
     def flush(self, step: Optional[int] = None) -> dict:
         """Rollup + push through the Accelerator's trackers (main-process
         gating happens inside each tracker, so calling this everywhere is
@@ -453,6 +634,8 @@ class TelemetrySession:
             if step is None:
                 step = values.get("sys/step")
             acc.log(values, step=step)
+        if self.flight is not None:
+            self.flight.note_snapshot(values)
         return values
 
     def close(self):
@@ -463,8 +646,19 @@ class TelemetrySession:
         for engine in self._engines:
             if getattr(engine, "telemetry", None) is self:
                 engine.telemetry = None
+        for ref in self._serving:
+            engine = ref()
+            if engine is not None and getattr(engine, "telemetry", None) is self:
+                engine.telemetry = None  # a live server must not feed a closed session
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.capture is not None:
+            self.capture.close()
+        if self.exporter is not None:
+            self.exporter.close()
+        if self.flight is not None:
+            self.flight.uninstall_hooks()
+        self.requests.close()
         if self.recorder is not None:
             from . import spans as _spans
 

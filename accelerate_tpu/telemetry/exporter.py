@@ -1,0 +1,140 @@
+"""Prometheus-style exposition of the telemetry session.
+
+The tracker plumbing (JSONL/TensorBoard/W&B) is pull-from-the-run; a
+fleet operator's monitoring is pull-from-outside. This module renders the
+live :class:`TelemetrySession` — the rolling rollup gauges plus the SLO
+histograms — as Prometheus text exposition format (version 0.0.4), and
+optionally serves it from a stdlib-HTTP scrape thread:
+
+    session = accelerator.telemetry
+    print(prometheus_text(session))            # one-shot
+    srv = ScrapeServer(session, port=9109)     # or TelemetryConfig(exporter_port=...)
+    # curl localhost:9109/metrics
+
+Histograms are rendered natively (``_bucket{le=...}``/``_sum``/``_count``
+straight from the log-bucket layout) *plus* precomputed ``_p50/_p95/_p99``
+gauges, so dashboards that can't run ``histogram_quantile`` still get the
+SLO lines. No third-party client library: the format is plain text and
+the server is ``http.server`` in a daemon thread.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+PREFIX = "att_"
+
+
+def _metric_name(key: str) -> str:
+    """``serving/ttft_p50_ms`` -> ``att_serving_ttft_p50_ms``."""
+    return PREFIX + _NAME_RE.sub("_", key.strip("/"))
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, (int,)):
+        return str(v)
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "NaN"
+    return repr(f)
+
+
+def prometheus_text(session) -> str:
+    """Render the session's gauges + histograms as Prometheus exposition
+    text. Never raises on a sick session: a gauge source that throws is
+    skipped (a scrape must not take the serving loop down)."""
+    lines = []
+    try:
+        values = session.rollup()
+    except Exception:
+        values = {}
+    for key in sorted(values):
+        v = values[key]
+        if isinstance(v, (dict, list, tuple, str)):
+            continue
+        name = _metric_name(key)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt(v)}")
+    for hname, hist in sorted(list(getattr(session, "hists", {}).items())):
+        try:
+            buckets = hist.cumulative_buckets()
+            if not buckets:
+                continue
+            # the serving thread may add() mid-scrape; derive the total
+            # from the snapshot so the +Inf bucket stays consistent
+            count = buckets[-1][1]
+            base = _metric_name(hname) + "_seconds"
+            lines.append(f"# TYPE {base} histogram")
+            for le, cum in buckets:
+                lines.append(f'{base}_bucket{{le="{le:.9g}"}} {cum}')
+            lines.append(f'{base}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{base}_sum {_fmt(hist.sum)}")
+            lines.append(f"{base}_count {count}")
+            for q in (0.50, 0.95, 0.99):
+                tag = f"p{int(q * 100)}"
+                lines.append(f"# TYPE {base}_{tag} gauge")
+                lines.append(f"{base}_{tag} {_fmt(hist.quantile(q))}")
+        except Exception:  # a racing histogram must not fail the scrape
+            continue
+    return "\n".join(lines) + "\n"
+
+
+class ScrapeServer:
+    """``/metrics`` scrape endpoint over the live session, on a daemon
+    thread. ``port=0`` binds an ephemeral port (``.port`` says which —
+    what the tests use); bind failures degrade to a warning, never an
+    exception, because an occupied port must not kill a training run."""
+
+    def __init__(self, session, port: int = 0, host: str = "127.0.0.1"):
+        import http.server
+
+        self.session = session
+        self.server = None
+        self.port: Optional[int] = None
+        exporter = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                if self.path not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = prometheus_text(exporter.session).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam stderr
+                pass
+
+        try:
+            self.server = http.server.ThreadingHTTPServer((host, port), Handler)
+        except OSError as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "telemetry exporter could not bind %s:%s (%s); scrape "
+                "endpoint disabled", host, port, e,
+            )
+            return
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, name="att-telemetry-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self):
+        if self.server is not None:
+            self.server.shutdown()
+            self.server.server_close()
+            self.server = None

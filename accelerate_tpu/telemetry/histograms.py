@@ -1,0 +1,138 @@
+"""Log-bucketed streaming histograms for SLO latency tracking.
+
+A serving stack's latency SLOs live in the tail — p99 TTFT and p99
+inter-token latency — and a tail is exactly what a rolling deque of raw
+samples loses the moment it evicts. These histograms keep **geometric
+buckets** instead: bucket ``i`` covers ``(lo * growth**(i-1), lo *
+growth**i]``, so any latency from microseconds to minutes lands in one of
+a few dozen integer counters with bounded (~``growth - 1``) relative
+error. Memory is O(buckets touched), adding a sample is one dict
+increment, and the quantile walk is O(buckets) — cheap enough to stay on
+for every request the engine ever serves, with no window to size and no
+eviction to bias the percentiles.
+
+The bucket layout doubles as the Prometheus histogram exposition
+(``exporter.py`` renders ``_bucket{le=...}`` lines straight from
+``cumulative_buckets()``), so the scrape endpoint and the in-process
+``snapshot()`` can never disagree about what was observed.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+class StreamingHistogram:
+    """Streaming log-bucketed histogram over positive values (seconds).
+
+    ``growth=1.25`` bounds quantile error at ~12% relative — far below
+    run-to-run latency noise — while covering 1 µs..1000 s in ~77 buckets.
+    """
+
+    def __init__(self, lo: float = 1e-6, growth: float = 1.25):
+        if not (lo > 0 and growth > 1):
+            raise ValueError(f"need lo > 0 and growth > 1, got {lo}, {growth}")
+        self.lo = float(lo)
+        self.growth = float(growth)
+        self._log_growth = math.log(self.growth)
+        self.counts: dict = {}  # bucket index -> count
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def add(self, value: float):
+        v = float(value)
+        if v != v or v < 0:  # NaN / negative clock skew: drop, don't poison
+            return
+        idx = 0 if v <= self.lo else 1 + int(math.log(v / self.lo) / self._log_growth)
+        self.counts[idx] = self.counts.get(idx, 0) + 1
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def upper_edge(self, idx: int) -> float:
+        """Inclusive upper bound of bucket ``idx``."""
+        return self.lo * self.growth ** idx
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (geometric bucket midpoint, clamped to the
+        observed min/max so tiny sample counts don't overshoot).
+        Snapshots the bucket dict first: the exporter's scrape thread reads
+        while the serving thread adds."""
+        counts = dict(self.counts)
+        if not counts:
+            return None
+        total = sum(counts.values())
+        target = q * total
+        seen = 0
+        lo_clamp, hi_clamp = self.min, self.max
+        for idx in sorted(counts):
+            seen += counts[idx]
+            if seen >= target:
+                hi = self.upper_edge(idx)
+                est = hi / math.sqrt(self.growth) if idx > 0 else hi
+                if lo_clamp is not None:
+                    est = max(est, lo_clamp)
+                if hi_clamp is not None:
+                    est = min(est, hi_clamp)
+                return est
+        return hi_clamp
+
+    def cumulative_buckets(self) -> list:
+        """[(le_seconds, cumulative_count), ...] ascending — the Prometheus
+        histogram series (the caller appends the +Inf bucket = count).
+        Snapshot-safe against a concurrent ``add``."""
+        counts = dict(self.counts)
+        out, seen = [], 0
+        for idx in sorted(counts):
+            seen += counts[idx]
+            out.append((self.upper_edge(idx), seen))
+        return out
+
+    def merge(self, other: "StreamingHistogram"):
+        """Fold another histogram (same lo/growth) in — the per-host merge
+        the ``trace`` CLI uses when summarizing multi-host request logs."""
+        if (other.lo, other.growth) != (self.lo, self.growth):
+            raise ValueError("histogram layouts differ; cannot merge")
+        for idx, n in other.counts.items():
+            self.counts[idx] = self.counts.get(idx, 0) + n
+        self.count += other.count
+        self.sum += other.sum
+        if other.min is not None:
+            self.min = other.min if self.min is None else min(self.min, other.min)
+        if other.max is not None:
+            self.max = other.max if self.max is None else max(self.max, other.max)
+
+    def snapshot(self) -> dict:
+        """{count, sum_s, min_s, max_s, mean_s, p50_s, p95_s, p99_s} or {}."""
+        if not self.count:
+            return {}
+        return {
+            "count": self.count,
+            "sum_s": self.sum,
+            "mean_s": self.sum / self.count,
+            "min_s": self.min,
+            "max_s": self.max,
+            "p50_s": self.quantile(0.50),
+            "p95_s": self.quantile(0.95),
+            "p99_s": self.quantile(0.99),
+        }
+
+
+def percentile_keys(name: str, hist: StreamingHistogram) -> dict:
+    """Flat rollup keys for one histogram: ``{name}_p50_ms`` etc. — what
+    ``TelemetrySession.rollup()`` folds into every tracker flush."""
+    snap = hist.snapshot()
+    if not snap:
+        return {}
+    return {
+        f"{name}_count": snap["count"],
+        f"{name}_p50_ms": round(snap["p50_s"] * 1e3, 3),
+        f"{name}_p95_ms": round(snap["p95_s"] * 1e3, 3),
+        f"{name}_p99_ms": round(snap["p99_s"] * 1e3, 3),
+        f"{name}_mean_ms": round(snap["mean_s"] * 1e3, 3),
+        f"{name}_max_ms": round(snap["max_s"] * 1e3, 3),
+    }

@@ -151,25 +151,62 @@ class MetricsWindow:
         return out
 
 
-def device_memory_stats() -> dict:
-    """Live/peak device memory of the first local device, when the backend
-    exposes it (TPU/GPU do; the CPU sim returns None — then {})."""
-    try:
-        import jax
+# last-seen peak-HBM per device (keyed by device id), so successive
+# flight-recorder snapshots report the watermark DELTA — "which incident
+# grew the peak". Only ``per_device=True`` (the bundle path) reads or
+# advances these marks: routine rollups/flushes/scrapes call with the
+# default and must not reset the bundle's baseline out from under it.
+_PEAK_MARKS: dict = {}
 
-        stats = jax.local_devices()[0].memory_stats()
-    except Exception:
-        return {}
-    if not stats:
-        return {}
+
+def device_memory_stats(per_device: bool = False, devices=None) -> dict:
+    """Live/peak device memory, when the backend exposes it.
+
+    Tolerates backends whose ``memory_stats()`` returns ``None``, raises,
+    or carries only some keys (each key is emitted only when present and
+    numeric). Device 0 provides the stable ``sys/mem_*`` gauges;
+    ``per_device=True`` (the flight-recorder bundle) additionally reports
+    every device's peak-HBM watermark and its growth since the previous
+    bundle snapshot (``sys/mem_peak_delta_bytes`` + ``_d<i>`` keys)."""
+    if devices is None:
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:
+            return {}
     out = {}
-    for src, dst in (
-        ("bytes_in_use", "sys/mem_bytes_in_use"),
-        ("peak_bytes_in_use", "sys/mem_peak_bytes"),
-        ("bytes_limit", "sys/mem_bytes_limit"),
-    ):
-        if src in stats:
-            out[dst] = int(stats[src])
+    deltas = []
+    for i, dev in enumerate(devices):
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        if i == 0:
+            for src, dst in (
+                ("bytes_in_use", "sys/mem_bytes_in_use"),
+                ("peak_bytes_in_use", "sys/mem_peak_bytes"),
+                ("bytes_limit", "sys/mem_bytes_limit"),
+            ):
+                v = stats.get(src)
+                if isinstance(v, (int, float)):
+                    out[dst] = int(v)
+        if not per_device:
+            continue
+        peak = stats.get("peak_bytes_in_use")
+        if not isinstance(peak, (int, float)):
+            continue
+        key = getattr(dev, "id", i)
+        last = _PEAK_MARKS.get(key)
+        delta = int(peak - last) if last is not None else 0
+        _PEAK_MARKS[key] = peak
+        deltas.append(delta)
+        out[f"sys/mem_peak_bytes_d{i}"] = int(peak)
+        out[f"sys/mem_peak_delta_bytes_d{i}"] = delta
+    if deltas:
+        out["sys/mem_peak_delta_bytes"] = max(deltas)
     return out
 
 

@@ -1,0 +1,319 @@
+"""Flight recorder + trigger-based profiler capture windows.
+
+When a serving host wedges mid-burst, the evidence is gone by the time a
+human attaches: the interesting state was the last few seconds of events.
+The flight recorder keeps a **bounded ring** of recent events and metric
+snapshots (near-zero cost: one deque append) and, on a trigger, dumps one
+self-contained **debug bundle** JSON:
+
+- the ring contents (request submits/finishes, steps, stalls, snapshots),
+- in-flight request ids with their state/slot/age and last lifecycle
+  event (from the request tracer),
+- the last closed telemetry spans (what the host was doing),
+- XLA compile counters, per-device memory stats with peak-HBM watermark
+  deltas, live-executable ``memory_analysis`` from attached serving
+  engines, and every python thread's stack.
+
+Triggers: an **unhandled exception** (``sys.excepthook`` chain), a
+**watchdog trip** (the session wires ``on_stall`` through), **SIGTERM**
+(dump, then chain to the previous handler so preemption semantics are
+unchanged), or an explicit ``dump()`` call.
+
+:class:`CaptureWindow` is the profiling analog: ``jax.profiler`` captures
+are too heavy to leave on, so a window opens only when told to — a
+configured step range (``TelemetryConfig(profile_steps=(N, M))``), or
+auto-armed when the straggler watchdog trips or the ITL p99 crosses
+``profile_trigger_itl_p99_ms`` — and closes itself after
+``profile_window_steps``. The resulting xplane trace lands next to the
+other telemetry artifacts (see docs/profiling.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+class FlightRecorder:
+    """Bounded event ring + debug-bundle dumper for one telemetry session."""
+
+    def __init__(self, session, dump_dir: Optional[str] = None,
+                 capacity: int = 256, process_index: int = 0):
+        self.session = session
+        self.dump_dir = dump_dir
+        self.process_index = process_index
+        self.ring: deque = deque(maxlen=max(8, int(capacity)))
+        self.dump_count = 0
+        self.last_bundle_path: Optional[str] = None
+        # reentrant: SIGTERM can land while the same thread is mid-dump
+        # (explicit dump / excepthook), and the handler dumps again
+        self._lock = threading.RLock()
+        self._prev_excepthook = None
+        self._prev_sigterm = None
+        self._hooks_installed = False
+
+    # -- producers ---------------------------------------------------------
+
+    def note(self, kind: str, **fields):
+        """Append one event to the ring (the per-event cost of leaving the
+        recorder on)."""
+        evt = {"t_unix_s": round(time.time(), 3), "kind": kind}
+        evt.update(fields)
+        self.ring.append(evt)
+
+    def note_snapshot(self, values: dict):
+        """Stash a (flat) metric rollup in the ring — called at flush
+        cadence so the bundle shows the gauges' recent trajectory."""
+        keep = {k: v for k, v in values.items()
+                if isinstance(v, (int, float, bool))}
+        self.note("metrics_snapshot", values=keep)
+
+    # -- trigger hooks -----------------------------------------------------
+
+    def install_hooks(self):
+        """Chain into ``sys.excepthook`` and SIGTERM (main thread only for
+        the signal). Both previous handlers keep running after the dump, so
+        tracebacks still print and preemption still terminates."""
+        if self._hooks_installed:
+            return
+        self._prev_excepthook = sys.excepthook
+        sys.excepthook = self._excepthook
+        try:
+            if threading.current_thread() is threading.main_thread():
+                self._prev_sigterm = signal.signal(signal.SIGTERM, self._on_sigterm)
+        except (ValueError, OSError):  # non-main thread / exotic runtime
+            self._prev_sigterm = None
+        self._hooks_installed = True
+
+    def uninstall_hooks(self):
+        if not self._hooks_installed:
+            return
+        if sys.excepthook is self._excepthook:
+            sys.excepthook = self._prev_excepthook or sys.__excepthook__
+        if self._prev_sigterm is not None:
+            try:
+                if signal.getsignal(signal.SIGTERM) is self._on_sigterm:
+                    signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except (ValueError, OSError):
+                pass
+        self._hooks_installed = False
+
+    def _excepthook(self, exc_type, exc, tb):
+        import traceback
+
+        try:
+            self.dump("unhandled_exception", extra={
+                "exception": "".join(
+                    traceback.format_exception_only(exc_type, exc)
+                ).strip(),
+            })
+        except Exception:
+            pass
+        (self._prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+    def _on_sigterm(self, signum, frame):
+        try:
+            self.dump("sigterm")
+        except Exception:
+            pass
+        prev = self._prev_sigterm
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            # restore + re-raise so the default disposition terminates us
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    # -- the bundle --------------------------------------------------------
+
+    def build_bundle(self, reason: str, extra: Optional[dict] = None) -> dict:
+        """Everything a post-mortem needs, each section individually
+        fail-soft (a dead backend must not lose the host-side evidence)."""
+        from .watchdog import _thread_stacks
+
+        bundle = {
+            "reason": reason,
+            "time_unix_s": round(time.time(), 3),
+            "wall_clock": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "process_index": self.process_index,
+            "events": list(self.ring),
+        }
+        if extra:
+            bundle.update(extra)
+        try:
+            from ..utils.compile_cache import compile_event_counters
+
+            bundle["compile_counters"] = compile_event_counters()
+        except Exception:
+            pass
+        try:
+            from .metrics import device_memory_stats
+
+            bundle["device_memory"] = device_memory_stats(per_device=True)
+        except Exception:
+            pass
+        session = self.session
+        if session is not None:
+            tracer = getattr(session, "requests", None)
+            if tracer is not None:
+                bundle["inflight_requests"] = tracer.inflight()
+            try:
+                from . import spans
+
+                bundle["last_spans"] = spans.last_spans(32)
+            except Exception:
+                pass
+            try:
+                bundle["executable_memory"] = session.executable_memory()
+            except Exception:
+                pass
+            try:
+                # host_rollup, not rollup: a full rollup device_gets pending
+                # loss/grad scalars, which blocks forever on the wedged
+                # backend this dump may be diagnosing
+                bundle["rollup"] = {
+                    k: v for k, v in session.host_rollup().items()
+                    if isinstance(v, (int, float, bool))
+                }
+            except Exception:
+                pass
+        bundle["thread_stacks"] = _thread_stacks()
+        return bundle
+
+    def dump(self, reason: str, extra: Optional[dict] = None) -> Optional[str]:
+        """Write one debug bundle; returns its path (None without a dump
+        dir — the bundle still lands on stderr as a one-line summary)."""
+        with self._lock:
+            bundle = self.build_bundle(reason, extra)
+            self.dump_count += 1
+            inflight = bundle.get("inflight_requests") or []
+            print(
+                f"[accelerate_tpu flight-recorder] {reason}: "
+                f"{len(bundle['events'])} ring events, "
+                f"{len(inflight)} in-flight requests "
+                f"[{', '.join(str(r['request_id']) for r in inflight[:16])}]",
+                file=sys.stderr,
+            )
+            if not self.dump_dir:
+                return None
+            try:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                path = os.path.join(
+                    self.dump_dir,
+                    f"flightrec-host{self.process_index}-{self.dump_count}.json",
+                )
+                with open(path, "w") as fh:
+                    json.dump(bundle, fh, indent=1, default=str)
+                self.last_bundle_path = path
+                return path
+            except OSError:
+                return None
+
+
+class CaptureWindow:
+    """Trigger-gated ``jax.profiler`` window keyed on session step counts.
+
+    ``start_step``/``stop_step`` come from config; :meth:`arm` (watchdog
+    trip, ITL SLO breach) opens a window at the next step for
+    ``window_steps`` steps. One window at a time; ``max_auto_arms`` bounds
+    trigger storms. The profiler start/stop callables are injectable so
+    tests exercise the trigger logic without a real capture.
+    """
+
+    def __init__(self, out_dir: str, start_step: Optional[int] = None,
+                 stop_step: Optional[int] = None, window_steps: int = 16,
+                 max_auto_arms: int = 1, start_fn=None, stop_fn=None):
+        self.out_dir = out_dir
+        self.start_step = start_step
+        self.stop_step = stop_step
+        self.window_steps = max(1, int(window_steps))
+        self.max_auto_arms = max_auto_arms
+        self.active = False
+        self.captures = 0
+        self._armed_reason: Optional[str] = None
+        self._armed_until: Optional[int] = None
+        self._auto_arms = 0
+        self._disabled = False
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+
+    def arm(self, reason: str = "trigger") -> bool:
+        """Open a capture window at the next step (no-op while one is
+        active or the auto-arm budget is spent)."""
+        if self._disabled or self.active or self._armed_reason is not None:
+            return False
+        if self._auto_arms >= self.max_auto_arms:
+            return False
+        self._auto_arms += 1
+        self._armed_reason = reason
+        return True
+
+    def _start(self, reason: str):
+        try:
+            if self._start_fn is not None:
+                self._start_fn(self.out_dir)
+            else:
+                import jax
+
+                os.makedirs(self.out_dir, exist_ok=True)
+                jax.profiler.start_trace(self.out_dir)
+        except Exception as e:
+            # one failed start disables the window for the session: a
+            # config-steps window would otherwise retry a raising
+            # start_trace on EVERY step, and a stale deadline would
+            # truncate a later window
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "profiler capture window disabled: start_trace failed (%r)", e
+            )
+            self._armed_reason = None
+            self._armed_until = None
+            self._disabled = True
+            return
+        self.active = True
+        self.reason = reason
+
+    def _stop(self):
+        try:
+            if self._stop_fn is not None:
+                self._stop_fn()
+            else:
+                import jax
+
+                jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self.active = False
+        self.captures += 1
+
+    def on_step(self, step: int):
+        """Advance the window state machine; called once per recorded step."""
+        if self._disabled:
+            return
+        if self.active:
+            if (self._armed_until is not None and step >= self._armed_until) or (
+                self._armed_until is None
+                and self.stop_step is not None and step >= self.stop_step
+            ):
+                self._armed_until = None
+                self._stop()
+            return
+        if self._armed_reason is not None:
+            reason, self._armed_reason = self._armed_reason, None
+            self._armed_until = step + self.window_steps
+            self._start(reason)
+            return
+        if (self.start_step is not None and self.stop_step is not None
+                and self.start_step <= step < self.stop_step):
+            self._start("config_steps")
+
+    def close(self):
+        if self.active:
+            self._stop()

@@ -171,7 +171,16 @@ class HeartbeatWatchdog:
     def _read_heartbeat():
         from ..state import PartialState
 
-        return PartialState._shared_state.get("telemetry_heartbeat")
+        hb = PartialState._shared_state.get("telemetry_heartbeat")
+        if hb is None:
+            # serving-only processes never construct PartialState; fall
+            # back to the live session's own beat so the watchdog still
+            # arms there
+            from . import current_session
+
+            session = current_session()
+            hb = getattr(session, "_last_beat", None) if session is not None else None
+        return hb
 
     def _run(self):
         while not self._stop.wait(self.poll_s):

@@ -317,6 +317,103 @@ class TestElasticLaunch:
         assert r.returncode == 7
 
 
+class TestTraceCommand:
+    """`accelerate-tpu trace` over the telemetry dir's serving artifacts
+    (the real writers are covered end-to-end in tests/test_serving.py;
+    here the fixtures pin the on-disk formats the CLI must keep reading)."""
+
+    def _telemetry_dir(self, tmp_path):
+        def span(name, ts, dur, pid, request_id=None):
+            e = {"name": name, "ph": "X", "cat": "serving", "ts": ts, "dur": dur,
+                 "pid": pid, "tid": 1}
+            if request_id is not None:
+                e["args"] = {"request_id": request_id}
+            return e
+
+        host0 = [
+            {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+             "args": {"name": "host0", "epoch_unix_s": 100.0}},
+            span("serving/request", 10.0, 50.0, 0, request_id=1),
+            span("serving/prefill_chunk", 12.0, 5.0, 0, request_id=2),
+        ]
+        host1 = [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+             "args": {"name": "host1", "epoch_unix_s": 101.0}},
+            span("serving/request", 20.0, 30.0, 1, request_id=1),
+        ]
+        reqs = [
+            {"request_id": 1, "prompt_len": 8, "max_new_tokens": 4, "slot": 0,
+             "submit_unix_s": 100.0, "queue_wait_ms": 1.5, "ttft_ms": 40.0,
+             "prefill_chunks": [{"start": 0, "bucket": 8, "ms": 30.0}],
+             "itl_ms": [2.0, 2.5, 3.0], "tokens": 4, "itl_p50_ms": 2.5,
+             "finish_reason": "budget", "total_ms": 55.0, "compiles_in_flight": 0},
+            {"request_id": 2, "prompt_len": 5, "max_new_tokens": 4, "slot": 1,
+             "submit_unix_s": 100.2, "queue_wait_ms": 12.0, "ttft_ms": 80.0,
+             "prefill_chunks": [{"start": 0, "bucket": 8, "ms": 25.0}],
+             "itl_ms": [2.2, 2.4], "tokens": 3, "itl_p50_ms": 2.4,
+             "finish_reason": "eos", "total_ms": 95.0, "compiles_in_flight": 0},
+        ]
+        for name, events in (("trace-host0.jsonl", host0), ("trace-host1.jsonl", host1)):
+            with open(tmp_path / name, "w") as fh:
+                fh.write("\n".join(json.dumps(e) for e in events) + "\n")
+        with open(tmp_path / "requests-host0.jsonl", "w") as fh:
+            fh.write("\n".join(json.dumps(r) for r in reqs) + "\n")
+        return tmp_path
+
+    def test_merge_aligns_hosts_on_one_clock(self, tmp_path):
+        d = self._telemetry_dir(tmp_path)
+        out = tmp_path / "merged.json"
+        r = _run(["trace", "merge", str(d), "-o", str(out)])
+        assert r.returncode == 0, r.stderr
+        trace = json.loads(out.read_text())
+        events = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert len(events) == 3
+        # host1's epoch is 1s later -> its events shift +1e6 us
+        host1 = next(e for e in events if e["pid"] == 1)
+        assert host1["ts"] == pytest.approx(20.0 + 1e6)
+
+    def test_merge_filters_one_request(self, tmp_path):
+        d = self._telemetry_dir(tmp_path)
+        r = _run(["trace", "merge", str(d), "--request-id", "1"])
+        assert r.returncode == 0, r.stderr
+        trace = json.loads(r.stdout)
+        events = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert len(events) == 2
+        assert all(e["args"]["request_id"] == 1 for e in events)
+
+    def test_summary_table_and_json(self, tmp_path):
+        d = self._telemetry_dir(tmp_path)
+        r = _run(["trace", "summary", str(d)])
+        assert r.returncode == 0, r.stderr
+        assert "ttft_ms" in r.stdout and "eos" in r.stdout
+        assert "2 requests, 7 tokens" in r.stdout
+        r = _run(["trace", "summary", str(d), "--json"])
+        data = json.loads(r.stdout)
+        assert data["aggregate"]["requests"] == 2
+        assert data["aggregate"]["finish_reasons"] == {"budget": 1, "eos": 1}
+        assert data["aggregate"]["ttft_p50_ms"] == pytest.approx(40.0, rel=0.15)
+        assert data["aggregate"]["itl_p99_ms"] == pytest.approx(3.0, rel=0.15)
+
+    def test_summary_single_request_detail(self, tmp_path):
+        d = self._telemetry_dir(tmp_path)
+        r = _run(["trace", "summary", str(d), "--request-id", "2"])
+        assert r.returncode == 0, r.stderr
+        rec = json.loads(r.stdout)
+        assert rec["finish_reason"] == "eos"
+        assert rec["prefill_chunks"][0]["bucket"] == 8
+
+    def test_missing_artifacts_fail_cleanly(self, tmp_path):
+        r = _run(["trace", "summary", str(tmp_path)])
+        assert r.returncode == 1 and "no request records" in r.stderr
+        r = _run(["trace", "merge", str(tmp_path)])
+        assert r.returncode == 1
+
+    def test_merge_unknown_request_id_errors(self, tmp_path):
+        d = self._telemetry_dir(tmp_path)
+        r = _run(["trace", "merge", str(d), "--request-id", "999"])
+        assert r.returncode == 1 and "999" in r.stderr
+
+
 class TestConfigMenu:
     """The arrow-key BulletMenu (reference commands/menu/ parity) and its
     non-TTY fallback used by `accelerate-tpu config`."""

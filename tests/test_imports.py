@@ -1,0 +1,57 @@
+"""Import-hygiene regression tests.
+
+The package (and the telemetry subsystem, which grows most often) must
+stay importable without dragging jax/flax in: the TTFT bench bills every
+worker's import chain to ``proc_startup_imports``, and the `trace` CLI is
+meant to run on machines that only hold the log files. The PR 3 lazy
+PEP-562 re-exports made this true; these tests keep it true.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _probe(statements: str) -> None:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-c", statements],
+        capture_output=True, text=True, env=env, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+class TestNoEagerHeavyImports:
+    def test_package_import_stays_light(self):
+        _probe(
+            "import sys; import accelerate_tpu\n"
+            "heavy = {m for m in ('jax', 'flax', 'optax') if m in sys.modules}\n"
+            "assert not heavy, f'import accelerate_tpu pulled {heavy}'"
+        )
+
+    def test_telemetry_import_stays_light(self):
+        """The telemetry package (requests/histograms/exporter/recorder
+        included) is host-side bookkeeping; jax must load only when a
+        session actually touches the backend."""
+        _probe(
+            "import sys\n"
+            "import accelerate_tpu.telemetry\n"
+            "import accelerate_tpu.telemetry.requests\n"
+            "import accelerate_tpu.telemetry.histograms\n"
+            "import accelerate_tpu.telemetry.exporter\n"
+            "import accelerate_tpu.telemetry.recorder\n"
+            "heavy = {m for m in ('jax', 'flax') if m in sys.modules}\n"
+            "assert not heavy, f'telemetry import pulled {heavy}'"
+        )
+
+    def test_trace_cli_module_stays_light(self):
+        """`accelerate-tpu trace` summarizes logs on machines with no
+        accelerator stack — the command module must not import jax."""
+        _probe(
+            "import sys\n"
+            "import accelerate_tpu.commands.trace\n"
+            "assert 'jax' not in sys.modules, 'trace CLI pulled jax'"
+        )

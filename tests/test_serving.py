@@ -243,6 +243,165 @@ class TestRecompileInvariant:
         assert engine.admission_recompiles == 0
 
 
+class TestRequestTracing:
+    """Request-level observability (accelerate_tpu/telemetry/requests.py):
+    a staggered-admission burst must leave one JSONL record per request
+    reconstructing its full lifecycle, SLO histogram snapshots via both
+    the session rollup and the Prometheus exposition, and request-tagged
+    spans in the Chrome-trace stream."""
+
+    def test_staggered_burst_records_rollups_and_exposition(self, served_model, tmp_path):
+        import json as json_mod
+
+        from accelerate_tpu.telemetry import (
+            TelemetryConfig,
+            TelemetrySession,
+            load_chrome_trace,
+        )
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+
+        model, cfg, params, prompts = served_model
+        session = TelemetrySession(TelemetryConfig(
+            trace_dir=str(tmp_path), watchdog=False, flight_hooks=False,
+        ))
+        try:
+            # 2 slots, 4 requests at staggered lengths -> admissions overlap
+            # in-flight decodes and late requests wait in queue
+            engine = ServingEngine(
+                model, params, num_slots=2, max_cache_len=64,
+                prefill_chunks=(4, 8), telemetry=session,
+            )
+            reqs = [engine.submit(p, max_new_tokens=4, seed=i)
+                    for i, p in enumerate(prompts)]
+            engine.serve(should_stop=lambda: all(r.done for r in reqs))
+
+            # (a) one record per request, full lifecycle
+            recs = [json_mod.loads(l)
+                    for l in open(tmp_path / "requests-host0.jsonl")]
+            assert len(recs) == len(prompts)
+            by_id = {r["request_id"]: r for r in recs}
+            for req in reqs:
+                rec = by_id[req.id]
+                assert rec["prompt_len"] == req.prompt.size
+                assert rec["tokens"] == 4 and rec["finish_reason"] == "budget"
+                assert rec["slot"] in (0, 1)
+                assert rec["queue_wait_ms"] >= 0 and rec["ttft_ms"] > 0
+                assert rec["total_ms"] >= rec["ttft_ms"]
+                # the chunk plan covers the prompt (padded tail included)
+                covered = sum(c["bucket"] for c in rec["prefill_chunks"])
+                assert covered >= rec["prompt_len"]
+                assert all(c["ms"] >= 0 for c in rec["prefill_chunks"])
+                assert len(rec["itl_ms"]) == 3  # 4 tokens -> 3 gaps
+                assert "compiles_in_flight" in rec
+
+            # (b) SLO snapshots through the session rollup...
+            rollup = session.rollup()
+            for key in ("serving/ttft_p50_ms", "serving/ttft_p95_ms",
+                        "serving/ttft_p99_ms", "serving/itl_p50_ms",
+                        "serving/itl_p95_ms", "serving/itl_p99_ms",
+                        "serving/queue_wait_p50_ms"):
+                assert rollup.get(key, 0) > 0, key
+            assert rollup["serving/ttft_count"] == len(prompts)
+            # ...and through the Prometheus text exposition
+            text = prometheus_text(session)
+            assert f'att_serving_ttft_seconds_bucket{{le="+Inf"}} {len(prompts)}' in text
+            for name in ("ttft", "itl", "queue_wait"):
+                for q in ("p50", "p95", "p99"):
+                    assert f"att_serving_{name}_seconds_{q} " in text, (name, q)
+
+            # request-tagged spans joined the Chrome-trace stream
+            session.close()
+            trace = load_chrome_trace(str(tmp_path / "trace-host0.jsonl"))
+            names = {e.get("name") for e in trace["traceEvents"]}
+            assert {"serving/request", "serving/prefill_chunk",
+                    "serving/queue_wait"} <= names
+            req_spans = [e for e in trace["traceEvents"]
+                         if e.get("name") == "serving/request"]
+            assert {e["args"]["request_id"] for e in req_spans} == {r.id for r in reqs}
+
+            # the trace CLI reads the same artifacts back
+            from accelerate_tpu.commands.trace import (
+                load_requests,
+                merge_traces,
+                summarize_requests,
+            )
+
+            merged = merge_traces(str(tmp_path), request_id=reqs[0].id)
+            tagged = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+            assert tagged and all(
+                e["args"]["request_id"] == reqs[0].id for e in tagged
+            )
+            agg = summarize_requests(load_requests(str(tmp_path)))
+            assert agg["requests"] == len(prompts)
+            assert agg["ttft_p50_ms"] > 0 and agg["itl_p99_ms"] > 0
+            assert agg["finish_reasons"] == {"budget": len(prompts)}
+        finally:
+            session.close()
+
+    def test_tracing_off_means_no_artifacts_and_no_hooks(self, served_model):
+        """With no session the engine's tracing layer is a single attribute
+        check — no tracer, no histograms, no files."""
+        model, cfg, params, prompts = served_model
+        engine = ServingEngine(
+            model, params, num_slots=1, max_cache_len=64, prefill_chunks=(8,)
+        )
+        assert engine.telemetry is None and engine._tracer() is None
+        engine.generate_batched(prompts[:1], max_new_tokens=3)
+        assert engine.requests_completed == 1
+
+    def test_watchdog_trip_dumps_flight_bundle_naming_inflight_requests(
+        self, served_model, tmp_path
+    ):
+        """An induced stall mid-burst must leave a flight-recorder bundle
+        naming the in-flight requests, their state/slots and last spans —
+        the evidence a wedged host otherwise takes with it."""
+        import json as json_mod
+        import time as time_mod
+
+        from accelerate_tpu.state import PartialState
+        from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession
+
+        PartialState()  # shared-dict heartbeat state must exist
+        model, cfg, params, prompts = served_model
+        session = TelemetrySession(TelemetryConfig(
+            trace_dir=str(tmp_path), watchdog=True, watchdog_deadline_s=0.3,
+            watchdog_poll_s=0.05, flight_hooks=False,
+        ))
+        try:
+            engine = ServingEngine(
+                model, params, num_slots=2, max_cache_len=64,
+                prefill_chunks=(8,), telemetry=session,
+            )
+            r1 = engine.submit(prompts[0], max_new_tokens=48, seed=0)
+            r2 = engine.submit(prompts[1], max_new_tokens=48, seed=1)
+            # admit both and decode a few steps (heartbeats flow), then stall
+            while len(engine._slot_req) < 2 or engine.step_count < 4:
+                engine.step()
+            assert not r1.done and not r2.done
+            deadline = time_mod.time() + 6.0
+            while session.flight.dump_count == 0 and time_mod.time() < deadline:
+                time_mod.sleep(0.05)
+            assert session.watchdog.stall_count >= 1
+            assert session.flight.dump_count >= 1
+            data = json_mod.load(open(session.flight.last_bundle_path))
+            assert data["reason"] == "watchdog_stall"
+            assert "STALL" in data["stall_report"]
+            inflight = {r["request_id"]: r for r in data["inflight_requests"]}
+            assert set(inflight) == {r1.id, r2.id}
+            for rid in (r1.id, r2.id):
+                assert inflight[rid]["state"] == "decode"
+                assert inflight[rid]["slot"] in (0, 1)
+                assert inflight[rid]["tokens"] >= 1
+                assert inflight[rid]["last_event"] in ("token", "first_token")
+            assert data["last_spans"], "span ring should show recent activity"
+            assert "thread_stacks" in data
+            # ring carries the request lifecycle events
+            kinds = {e["kind"] for e in data["events"]}
+            assert "request_submit" in kinds and "step" in kinds
+        finally:
+            session.close()
+
+
 class TestTelemetryIntegration:
     def test_metrics_flow_through_session_rollup(self, served_model, tmp_path):
         from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession

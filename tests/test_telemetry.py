@@ -292,6 +292,11 @@ class TestConfigResolution:
         monkeypatch.setenv("ATT_TELEMETRY_DIR", "/tmp/telem")
         cfg = resolve_config(None)
         assert cfg is not None and cfg.trace_dir == "/tmp/telem"
+        monkeypatch.setenv("ATT_TELEMETRY_PROFILE_STEPS", "3:9")
+        assert resolve_config(None).profile_steps == (3, 9)
+        # malformed window must degrade to a warning, not crash startup
+        monkeypatch.setenv("ATT_TELEMETRY_PROFILE_STEPS", "100")
+        assert resolve_config(None).profile_steps is None
 
 
 class TestTrackerGating:
@@ -409,6 +414,299 @@ class TestPrngImplLog:
         hits = [r for r in caplog.records if "PRNG impl resolved" in r.getMessage()]
         assert len(hits) == 1
         assert "threefry" in hits[0].getMessage()  # CPU backend resolves to default
+
+
+class TestStreamingHistogram:
+    def test_quantiles_within_bucket_error(self):
+        from accelerate_tpu.telemetry.histograms import StreamingHistogram
+
+        h = StreamingHistogram()
+        for i in range(1, 1001):  # 1ms .. 1s, uniform
+            h.add(i / 1000)
+        # geometric buckets (growth=1.25) bound relative error at ~12%
+        assert h.quantile(0.50) == pytest.approx(0.5, rel=0.13)
+        assert h.quantile(0.95) == pytest.approx(0.95, rel=0.13)
+        assert h.quantile(0.99) == pytest.approx(0.99, rel=0.13)
+        snap = h.snapshot()
+        assert snap["count"] == 1000
+        assert snap["min_s"] == 0.001 and snap["max_s"] == 1.0
+        assert snap["sum_s"] == pytest.approx(500.5)
+
+    def test_empty_and_garbage_inputs(self):
+        from accelerate_tpu.telemetry.histograms import StreamingHistogram
+
+        h = StreamingHistogram()
+        assert h.quantile(0.5) is None and h.snapshot() == {}
+        h.add(-1.0)
+        h.add(float("nan"))
+        assert h.count == 0
+        h.add(0.0)  # at/below lo lands in bucket 0, not a crash
+        assert h.count == 1 and h.quantile(0.99) == 0.0
+
+    def test_cumulative_buckets_are_monotone_and_complete(self):
+        from accelerate_tpu.telemetry.histograms import StreamingHistogram
+
+        h = StreamingHistogram()
+        for v in (0.001, 0.002, 0.004, 0.1, 0.1, 3.0):
+            h.add(v)
+        buckets = h.cumulative_buckets()
+        les = [le for le, _ in buckets]
+        cums = [c for _, c in buckets]
+        assert les == sorted(les)
+        assert cums == sorted(cums) and cums[-1] == h.count
+
+    def test_merge_matches_combined_stream(self):
+        from accelerate_tpu.telemetry.histograms import StreamingHistogram
+
+        a, b, both = StreamingHistogram(), StreamingHistogram(), StreamingHistogram()
+        for i, v in enumerate(x / 100 for x in range(1, 200)):
+            (a if i % 2 else b).add(v)
+            both.add(v)
+        a.merge(b)
+        assert a.count == both.count and a.sum == pytest.approx(both.sum)
+        assert a.quantile(0.95) == both.quantile(0.95)
+
+    def test_percentile_keys(self):
+        from accelerate_tpu.telemetry.histograms import (
+            StreamingHistogram,
+            percentile_keys,
+        )
+
+        h = StreamingHistogram()
+        assert percentile_keys("serving/ttft", h) == {}
+        h.add(0.1)
+        out = percentile_keys("serving/ttft", h)
+        assert out["serving/ttft_count"] == 1
+        assert out["serving/ttft_p99_ms"] == pytest.approx(100, rel=0.13)
+
+
+class TestDeviceMemoryStats:
+    def test_tolerates_none_partial_and_tracks_peak_deltas(self):
+        from accelerate_tpu.telemetry import metrics as metrics_mod
+
+        class Dev:
+            def __init__(self, id, stats):
+                self.id = id
+                self._stats = stats
+
+            def memory_stats(self):
+                if isinstance(self._stats, Exception):
+                    raise self._stats
+                return self._stats
+
+        metrics_mod._PEAK_MARKS.clear()
+        d0 = Dev(0, {"bytes_in_use": 10, "peak_bytes_in_use": 100})
+        d1 = Dev(1, None)                            # CPU-sim style
+        d2 = Dev(2, {"peak_bytes_in_use": 50})       # partial keys
+        d3 = Dev(3, RuntimeError("backend gone"))
+        out = metrics_mod.device_memory_stats(per_device=True, devices=[d0, d1, d2, d3])
+        assert out["sys/mem_bytes_in_use"] == 10
+        assert out["sys/mem_peak_bytes"] == 100
+        assert "sys/mem_bytes_limit" not in out      # absent key stays absent
+        assert out["sys/mem_peak_delta_bytes_d0"] == 0  # first snapshot = baseline
+        # peaks grow between snapshots -> per-device watermark deltas
+        d0._stats["peak_bytes_in_use"] = 160
+        d2._stats["peak_bytes_in_use"] = 55
+        out2 = metrics_mod.device_memory_stats(per_device=True, devices=[d0, d1, d2, d3])
+        assert out2["sys/mem_peak_delta_bytes_d0"] == 60
+        assert out2["sys/mem_peak_delta_bytes_d2"] == 5
+        assert out2["sys/mem_peak_delta_bytes"] == 60
+        # a backend with nothing to say yields {}
+        assert metrics_mod.device_memory_stats(devices=[Dev(9, None)]) == {}
+        metrics_mod._PEAK_MARKS.clear()
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_and_bundle_contents(self, tmp_path):
+        from accelerate_tpu.telemetry.recorder import FlightRecorder
+
+        fr = FlightRecorder(None, dump_dir=str(tmp_path), capacity=16)
+        for i in range(40):
+            fr.note("evt", i=i)
+        assert len(fr.ring) == 16  # bounded: cheap enough to leave on
+        path = fr.dump("manual", extra={"marker": "x"})
+        data = json.load(open(path))
+        assert data["reason"] == "manual" and data["marker"] == "x"
+        assert [e["i"] for e in data["events"]] == list(range(24, 40))
+        assert "thread_stacks" in data and "compile_counters" in data
+
+    def test_excepthook_chains_and_dumps(self, tmp_path):
+        import sys
+
+        from accelerate_tpu.telemetry.recorder import FlightRecorder
+
+        fr = FlightRecorder(None, dump_dir=str(tmp_path))
+        prev_called = []
+        old_hook = sys.excepthook
+        sys.excepthook = lambda *a: prev_called.append(a)
+        try:
+            fr.install_hooks()
+            try:
+                raise ValueError("boom-for-the-bundle")
+            except ValueError:
+                sys.excepthook(*sys.exc_info())
+            assert fr.dump_count == 1
+            assert prev_called, "previous excepthook must still run"
+            data = json.load(open(fr.last_bundle_path))
+            assert data["reason"] == "unhandled_exception"
+            assert "boom-for-the-bundle" in data["exception"]
+        finally:
+            fr.uninstall_hooks()
+            sys.excepthook = old_hook
+
+    def test_sigterm_dumps_bundle_in_subprocess(self, tmp_path):
+        """SIGTERM (the preemption path) must leave a debug bundle behind
+        and still terminate the process with the default disposition."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        code = (
+            "import os, signal\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession\n"
+            f"s = TelemetrySession(TelemetryConfig(trace_dir={str(tmp_path)!r}, "
+            "spans=False, watchdog=False))\n"
+            "s.flight.note('marker', detail='pre-term')\n"
+            "os.kill(os.getpid(), signal.SIGTERM)\n"
+            "raise SystemExit('unreachable: SIGTERM must terminate')\n"
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, env=env, timeout=300, cwd=repo)
+        assert r.returncode == -15, (r.returncode, r.stdout, r.stderr)
+        bundles = sorted(tmp_path.glob("flightrec-host0-*.json"))
+        assert bundles, r.stderr
+        data = json.load(open(bundles[-1]))
+        assert data["reason"] == "sigterm"
+        assert any(e.get("kind") == "marker" for e in data["events"])
+
+
+class TestRequestTracerDrain:
+    def test_close_drains_inflight_as_evicted(self, tmp_path):
+        """Requests still in flight at tracer close must reconcile: one
+        record each with finish_reason 'evicted', not a silent gap."""
+        from accelerate_tpu.telemetry.requests import RequestTracer
+
+        path = str(tmp_path / "requests.jsonl")
+        tracer = RequestTracer(None, path)
+        req = types.SimpleNamespace(prompt=np.zeros(4, np.int32), id=7,
+                                    max_new_tokens=8, submit_t=time.perf_counter())
+        tracer.on_submit(req)
+        assert [r["request_id"] for r in tracer.inflight()] == [7]
+        tracer.close()
+        recs = [json.loads(l) for l in open(path)]
+        assert len(recs) == 1
+        assert recs[0]["request_id"] == 7
+        assert recs[0]["finish_reason"] == "evicted"
+        assert recs[0]["total_ms"] >= 0 and "compiles_in_flight" in recs[0]
+        assert tracer.inflight() == []
+
+
+class TestCaptureWindow:
+    def test_configured_step_window_opens_and_closes(self):
+        from accelerate_tpu.telemetry.recorder import CaptureWindow
+
+        calls = []
+        cw = CaptureWindow("out", start_step=3, stop_step=5,
+                           start_fn=lambda d: calls.append(("start", d)),
+                           stop_fn=lambda: calls.append(("stop",)))
+        for step in range(1, 9):
+            cw.on_step(step)
+        assert calls == [("start", "out"), ("stop",)]
+        assert cw.captures == 1 and not cw.active
+
+    def test_arm_opens_bounded_window_with_trigger_budget(self):
+        from accelerate_tpu.telemetry.recorder import CaptureWindow
+
+        calls = []
+        cw = CaptureWindow("out", window_steps=3, max_auto_arms=1,
+                           start_fn=lambda d: calls.append("start"),
+                           stop_fn=lambda: calls.append("stop"))
+        assert cw.arm("watchdog_stall")
+        for step in range(10, 20):
+            cw.on_step(step)
+        assert calls == ["start", "stop"]  # window closed after 3 steps
+        assert not cw.arm("again"), "auto-arm budget must bound trigger storms"
+
+    def test_itl_slo_breach_auto_arms_via_session(self, tmp_path):
+        """ITL p99 crossing the configured threshold arms a capture window
+        on the very next recorded step."""
+        from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession
+
+        session = TelemetrySession(TelemetryConfig(
+            trace_dir=str(tmp_path), spans=False, watchdog=False,
+            flight_hooks=False, profile_trigger_itl_p99_ms=5.0,
+            profile_window_steps=2,
+        ))
+        try:
+            calls = []
+            session.capture._start_fn = lambda d: calls.append("start")
+            session.capture._stop_fn = lambda: calls.append("stop")
+            engine = types.SimpleNamespace(step_count=0)
+            itl = session.histogram("serving/itl")
+            for _ in range(20):
+                itl.add(0.001)  # healthy: 1ms, under the 5ms SLO
+            engine.step_count = 1
+            session.on_step(engine, 0.01)
+            assert calls == [] and session.capture.captures == 0
+            for _ in range(8):
+                itl.add(0.5)  # tail blows through the SLO
+            for step in (2, 3, 4, 5):
+                engine.step_count = step
+                session.on_step(engine, 0.01)
+            assert calls == ["start", "stop"]
+            assert session.capture.captures == 1
+        finally:
+            session.close()
+
+
+class TestExporter:
+    def test_prometheus_text_renders_gauges_and_histograms(self, tmp_path):
+        from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+
+        session = TelemetrySession(TelemetryConfig(
+            trace_dir=str(tmp_path), spans=False, watchdog=False,
+            flight_hooks=False,
+        ))
+        try:
+            h = session.histogram("serving/ttft")
+            for v in (0.01, 0.02, 0.5):
+                h.add(v)
+            session.window.add({"step": 1, "wall_s": 0.5, "tokens": 100})
+            text = prometheus_text(session)
+            assert "# TYPE att_sys_tokens_per_s gauge" in text
+            assert "# TYPE att_serving_ttft_seconds histogram" in text
+            assert 'att_serving_ttft_seconds_bucket{le="+Inf"} 3' in text
+            assert "att_serving_ttft_seconds_count 3" in text
+            assert "att_serving_ttft_seconds_p99" in text
+            # cumulative bucket counts are monotone
+            cums = [int(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+                    if l.startswith("att_serving_ttft_seconds_bucket")]
+            assert cums == sorted(cums)
+        finally:
+            session.close()
+
+    def test_scrape_thread_serves_metrics(self, tmp_path):
+        import urllib.request
+
+        from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession
+
+        session = TelemetrySession(TelemetryConfig(
+            trace_dir=str(tmp_path), spans=False, watchdog=False,
+            flight_hooks=False, exporter_port=0,
+        ))
+        try:
+            assert session.exporter is not None and session.exporter.port
+            session.histogram("serving/itl").add(0.002)
+            url = f"http://127.0.0.1:{session.exporter.port}/metrics"
+            body = urllib.request.urlopen(url, timeout=10).read().decode()
+            assert "att_serving_itl_seconds_count 1" in body
+        finally:
+            session.close()
 
 
 class TestEngineIntegration:
