@@ -522,6 +522,12 @@ class TrainEngine:
         scale = self.scale_state["scale"] if self.scale_state is not None else None
         if self.telemetry is not None:
             self.telemetry.note_batch(args, kwargs, self._call_argnames)
+            from .telemetry import forensics as _forensics
+
+            _forensics.note_call(
+                "train_fwd_bwd",
+                {"args": t_args, "kwargs": t_kw, "statics": (s_args, s_kw)},
+            )
 
         fwd_bwd = self._get_jit(
             "fwd_bwd",
@@ -971,12 +977,27 @@ class TrainEngine:
             fused_fn = multi_fn
         else:
             fused_fn = step_fn
-        jitted = jax.jit(fused_fn, donate_argnums=(0, 1) if self.donate_state else ())
+        donate = (0, 1) if self.donate_state else ()
+        jitted = jax.jit(fused_fn, donate_argnums=donate)
+        if self.telemetry is not None:
+            from .telemetry import forensics as _forensics
+
+            _forensics.register(
+                "train_step", donate=donate,
+                statics={"micro_steps": micro, "steps_per_call": steps_per_call},
+            )
+        cost_captured = []
 
         def run(batch):
             tm = self.telemetry
             t0 = time.perf_counter() if tm is not None else None
             rng_key = default_keychain().next_key("train_step")
+            if tm is not None:
+                from .telemetry import forensics as _forensics
+
+                # fingerprint BEFORE dispatch: a changed batch signature
+                # here is the recompile this very call is about to pay
+                _forensics.note_call("train_step", {"batch": batch})
             new_params, new_opt, new_extra, new_scale, skipped, metrics = jitted(
                 self.params, self.opt_state, self.extra_state, self.scale_state, rng_key, batch
             )
@@ -998,8 +1019,22 @@ class TrainEngine:
                     self, time.perf_counter() - t0, tokens=tokens,
                     samples=samples, seq_len=seq_len,
                     steps=steps_per_call if steps_per_call else 1,
-                    metrics=metrics,
+                    metrics=metrics, exe="train_step",
                 )
+                if tm.costs is not None and not cost_captured:
+                    # once, on the (warmup) first step: re-lower against
+                    # the live avals (one trace, no backend compile — the
+                    # compiled-form memory analysis is added only when the
+                    # persistent cache can serve it) so the roofline row
+                    # exists from step 1
+                    cost_captured.append(True)
+                    try:
+                        tm.costs.capture_lowered("train_step", jitted.lower(
+                            self.params, self.opt_state, self.extra_state,
+                            self.scale_state, rng_key, batch,
+                        ))
+                    except Exception:
+                        pass
             return metrics
 
         return run
@@ -1278,6 +1313,10 @@ class TrainEngine:
             tm = self.telemetry
             t0 = time.perf_counter() if tm is not None else None
             rng_key = default_keychain().next_key("train_step")
+            if tm is not None:
+                from .telemetry import forensics as _forensics
+
+                _forensics.note_call("train_step", {"batch": batch})
             new_params, new_opt, new_es, new_scale, new_comp, skipped, metrics = jitted(
                 self.params, self.opt_state, self.extra_state, self.scale_state,
                 self._comp_state, rng_key, batch
@@ -1298,6 +1337,7 @@ class TrainEngine:
                 tm.on_step(
                     self, time.perf_counter() - t0, tokens=tokens,
                     samples=samples, seq_len=seq_len, metrics=metrics,
+                    exe="train_step",
                 )
             return metrics
 

@@ -335,6 +335,11 @@ class DispatchedModel:
         # O(num_params) Python work on the dispatch hot path; a placement
         # drift surfaces as TypeError/ValueError and falls back to jit
         self._aot[aot_key] = compiled
+        from .telemetry import current_session
+
+        session = current_session()
+        if session is not None and getattr(session, "costs", None) is not None:
+            session.costs.capture("dispatch_forward", compiled)
         return self
 
     def __call__(self, *args, **kwargs):
@@ -361,6 +366,15 @@ class DispatchedModel:
                 return out
             except (TypeError, ValueError):  # placement drifted from the AOT avals
                 pass
+        from .telemetry import forensics
+
+        # the jit fallback is where AOT misses silently recompile — the
+        # classic "dispatch was fast once, slow forever after a reshape"
+        forensics.note_call(
+            "dispatch_forward",
+            {"args": traced_args, "kwargs": traced_kw,
+             "statics": (static_args, static_kw)},
+        )
         return jitted(params, traced_args, traced_kw, static_args, static_kw)
 
     def param_placer(self):

@@ -28,6 +28,7 @@ import numpy as np
 
 from .logging import get_logger
 from .state import PartialState
+from .utils.phases import phase
 from .utils.constants import (
     CUSTOM_STATE_PATTERN,
     DATALOADER_STATE_NAME,
@@ -57,6 +58,21 @@ def save_accelerator_state(
     safe_serialization: bool = True,
 ):
     """reference checkpointing.py:52."""
+    # checkpoint/save rides utils/phases: the span lands in the Chrome
+    # trace, the goodput ledger bills the wall to its checkpoint bucket,
+    # and the flight-recorder bundle sees it via the span ring — no
+    # checkpoint-specific telemetry plumbing anywhere else.
+    with phase("checkpoint/save"):
+        return _save_accelerator_state(
+            output_dir, engines, schedulers, dataloaders, custom_objects,
+            step, safe_serialization,
+        )
+
+
+def _save_accelerator_state(
+    output_dir, engines, schedulers, dataloaders, custom_objects, step,
+    safe_serialization,
+):
     state = PartialState()
     os.makedirs(output_dir, exist_ok=True)
     ext = "safetensors" if safe_serialization else "bin"
@@ -142,6 +158,15 @@ def load_accelerator_state(
     custom_objects=(),
 ) -> Optional[int]:
     """reference checkpointing.py:164. Returns the step override."""
+    with phase("checkpoint/restore"):
+        return _load_accelerator_state(
+            input_dir, engines, schedulers, dataloaders, custom_objects
+        )
+
+
+def _load_accelerator_state(
+    input_dir, engines, schedulers, dataloaders, custom_objects
+) -> Optional[int]:
     state = PartialState()
     override_step = None
     trainer_state = {}

@@ -1,0 +1,304 @@
+"""`accelerate-tpu report` — the doctor's read of a telemetry dir.
+
+`trace` answers "show me the timeline"; `report` answers "where did the
+time go and why". It merges everything a session leaves behind —
+
+    goodput-host<i>.json     wall-clock partition (the goodput ledger)
+    costs-host<i>.json       per-executable roofline rows (cost registry)
+    forensics-host<i>.jsonl  diagnosed recompiles with their causes
+    metrics-host<i>.jsonl    per-step records (optional)
+    requests-host<i>.jsonl   serving request log (optional)
+
+— into one explanation:
+
+    accelerate-tpu report runs/exp/telemetry
+    accelerate-tpu report runs/exp/telemetry --json
+
+The text form prints the goodput breakdown (fractions sum to 1.0), the
+top executables by measured wall with their roofline class and cost-model
+MFU / bandwidth utilization, and every recompile with the exact argument
+and aval change that caused it. Pure stdlib + the telemetry host modules:
+no jax import, so it runs anywhere the artifacts land.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+BAR_WIDTH = 24
+
+
+def _host_files(target: str, pattern: str) -> list:
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, pattern)))
+    return []
+
+
+def _host_of(path: str, prefix: str) -> str:
+    name = os.path.basename(path)
+    stem = name.split(".", 1)[0]
+    return stem[len(prefix):] if stem.startswith(prefix) else "?"
+
+
+def _load_json(path: str):
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _load_jsonl(path: str) -> list:
+    out = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    except (OSError, ValueError):
+        pass
+    return out
+
+
+def load_goodput(target: str) -> dict:
+    """Merged goodput: per-host snapshots plus an aggregate over summed
+    bucket seconds (an idle host dilutes fleet goodput — that is the
+    point of fleet accounting)."""
+    from ..telemetry.goodput import BUCKETS
+
+    hosts = {}
+    for path in _host_files(target, "goodput-host*.json"):
+        data = _load_json(path)
+        if data:
+            hosts[_host_of(path, "goodput-host")] = data
+    if not hosts:
+        return {}
+    seconds = {b: 0.0 for b in BUCKETS}
+    elapsed = 0.0
+    for data in hosts.values():
+        elapsed += data.get("elapsed_s") or 0.0
+        for b in BUCKETS:
+            seconds[b] += (data.get("seconds") or {}).get(b) or 0.0
+    total = sum(seconds.values())
+    fractions = {b: (seconds[b] / total if total > 0 else 0.0) for b in BUCKETS}
+    return {"hosts": hosts, "seconds": seconds, "fractions": fractions,
+            "elapsed_s": elapsed}
+
+
+def load_costs(target: str) -> dict:
+    """Merged cost registry: rows keyed by executable name, wall/calls
+    summed across hosts, static cost fields from the first host that
+    captured them."""
+    merged: dict = {}
+    peaks = {}
+    for path in _host_files(target, "costs-host*.json"):
+        data = _load_json(path)
+        if not data:
+            continue
+        for key in ("peak_flops", "peak_hbm_bw", "ridge_intensity"):
+            if data.get(key) and key not in peaks:
+                peaks[key] = data[key]
+        for row in data.get("executables") or []:
+            name = row.get("name")
+            if name is None:
+                continue
+            cur = merged.get(name)
+            if cur is None:
+                merged[name] = dict(row)
+            else:
+                cur["wall_s"] = round(cur.get("wall_s", 0.0) + (row.get("wall_s") or 0.0), 4)
+                cur["calls"] = cur.get("calls", 0) + (row.get("calls") or 0)
+                for k, v in row.items():
+                    cur.setdefault(k, v)
+    rows = sorted(merged.values(), key=lambda r: -(r.get("wall_s") or 0.0))
+    # re-derive the utilization numbers over the merged wall
+    pf, pb = peaks.get("peak_flops"), peaks.get("peak_hbm_bw")
+    for row in rows:
+        wall, calls = row.get("wall_s") or 0.0, row.get("calls") or 0
+        if wall > 0 and calls > 0:
+            if row.get("flops_per_call") and pf:
+                row["mfu_model_pct"] = round(
+                    100.0 * row["flops_per_call"] * calls / wall / pf, 3)
+            if row.get("hbm_bytes_per_call") and pb:
+                row["bw_util_pct"] = round(
+                    100.0 * row["hbm_bytes_per_call"] * calls / wall / pb, 3)
+    return {**peaks, "executables": rows}
+
+
+def load_forensics(target: str) -> list:
+    """Every forensics record (host-tagged, oldest first)."""
+    out = []
+    for path in _host_files(target, "forensics-host*.jsonl"):
+        host = _host_of(path, "forensics-host")
+        for rec in _load_jsonl(path):
+            rec.setdefault("host", host)
+            out.append(rec)
+    out.sort(key=lambda r: r.get("time_unix_s", 0))
+    return out
+
+
+def load_steps(target: str) -> dict:
+    """Aggregate of the per-step metrics JSONL (when the run wrote one)."""
+    walls, tokens, compiles = [], 0, 0
+    for path in _host_files(target, "metrics-host*.jsonl"):
+        for rec in _load_jsonl(path):
+            if rec.get("wall_s"):
+                walls.append(float(rec["wall_s"]) / max(int(rec.get("steps", 1)), 1))
+            tokens += rec.get("tokens") or 0
+            compiles += rec.get("compile_events") or 0
+    if not walls:
+        return {}
+    walls.sort()
+    return {
+        "steps": len(walls),
+        "step_time_p50_s": round(walls[len(walls) // 2], 4),
+        "step_time_max_s": round(walls[-1], 4),
+        "tokens": tokens,
+        "compile_events": compiles,
+    }
+
+
+def load_report(target: str) -> dict:
+    forensics = load_forensics(target)
+    data = {
+        "target": target,
+        "goodput": load_goodput(target),
+        "costs": load_costs(target),
+        "recompiles": [r for r in forensics if r.get("event") == "recompile"],
+        "first_compiles": [r for r in forensics
+                           if r.get("event") == "first_compile"],
+        "steps": load_steps(target),
+    }
+    req_files = _host_files(target, "requests-host*.jsonl")
+    if req_files:
+        from .trace import load_requests, summarize_requests
+
+        data["requests"] = summarize_requests(load_requests(target))
+    return data
+
+
+def _bar(frac: float) -> str:
+    n = int(round(max(0.0, min(frac, 1.0)) * BAR_WIDTH))
+    return "#" * n + "." * (BAR_WIDTH - n)
+
+
+def format_report(data: dict) -> str:
+    lines = [f"== accelerate-tpu report: {data.get('target', '?')} =="]
+
+    gp = data.get("goodput") or {}
+    if gp:
+        fr = gp["fractions"]
+        lines.append("")
+        lines.append(
+            f"goodput breakdown ({len(gp.get('hosts') or {})} host(s), "
+            f"{gp.get('elapsed_s', 0):.1f}s wall; fractions sum to "
+            f"{sum(fr.values()):.2f}):"
+        )
+        order = ("compute", "compile", "checkpoint", "data_wait", "stall", "idle")
+        for b in order:
+            f = fr.get(b, 0.0)
+            secs = (gp.get("seconds") or {}).get(b, 0.0)
+            lines.append(f"  {b:<10} {100 * f:6.1f}%  {_bar(f)}  {secs:9.2f}s")
+        lines.append(f"  goodput (productive compute) = {100 * fr.get('compute', 0.0):.1f}%")
+    else:
+        lines.append("")
+        lines.append("goodput breakdown: no goodput-host*.json found "
+                     "(run with telemetry enabled)")
+
+    costs = data.get("costs") or {}
+    rows = costs.get("executables") or []
+    lines.append("")
+    if rows:
+        ridge = costs.get("ridge_intensity")
+        ridge_txt = f"{ridge:.1f}" if isinstance(ridge, (int, float)) else "?"
+        lines.append("top executables by measured wall (roofline vs "
+                     f"ridge {ridge_txt} flops/byte):")
+        header = ("executable", "wall_s", "calls", "class", "AI",
+                  "MFU(model)", "BW util")
+        table = [header]
+        for row in rows[:10]:
+            mfu = row.get("mfu_model_pct")
+            bw = row.get("bw_util_pct")
+            table.append((
+                str(row.get("name")),
+                f"{row.get('wall_s', 0.0):.3f}" if row.get("wall_s") is not None else "",
+                str(row.get("calls", "")),
+                row.get("roofline", "?"),
+                f"{row['arith_intensity']:.2f}" if row.get("arith_intensity") is not None else "",
+                f"{mfu:.2f}%" if mfu is not None else "",
+                f"{bw:.2f}%" if bw is not None else "",
+            ))
+        widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+        for r in table:
+            lines.append("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    else:
+        lines.append("executables: no costs-host*.json found")
+
+    recs = data.get("recompiles") or []
+    firsts = data.get("first_compiles") or []
+    lines.append("")
+    lines.append(f"recompiles ({len(recs)} diagnosed, "
+                 f"{len(firsts)} first compiles):")
+    for rec in recs:
+        t = rec.get("time_unix_s")
+        comp = rec.get("compile_s")
+        hits = rec.get("compile_cache_hits") or 0
+        suffix = []
+        if comp is not None:
+            suffix.append(f"compile {comp:.2f}s")
+        suffix.append(f"{rec.get('compile_events', '?')} events")
+        if hits:
+            suffix.append(f"{hits} cache hits")
+        stamp = f"[host {rec.get('host', '?')}" + (
+            f" @{t:.0f}] " if isinstance(t, (int, float)) else "] ")
+        lines.append(f"  {stamp}{rec.get('cause')}  ({', '.join(suffix)})")
+    if not recs:
+        lines.append("  none — every entry point held its steady-state signature")
+
+    steps = data.get("steps") or {}
+    if steps:
+        lines.append("")
+        lines.append(
+            f"steps: {steps['steps']} recorded, p50 {steps['step_time_p50_s']}s, "
+            f"max {steps['step_time_max_s']}s, {steps['tokens']} tokens, "
+            f"{steps['compile_events']} compile events"
+        )
+    req = data.get("requests") or {}
+    if req.get("requests"):
+        lines.append(
+            f"serving: {req.get('requests')} requests, {req.get('tokens')} tokens"
+            + (f", ttft p50/p99 = {req.get('ttft_p50_ms')}/{req.get('ttft_p99_ms')} ms"
+               if req.get("ttft_p50_ms") is not None else "")
+        )
+    return "\n".join(lines)
+
+
+def report_command(args) -> int:
+    data = load_report(args.target)
+    if not (data["goodput"] or data["costs"].get("executables")
+            or data["recompiles"] or data["first_compiles"] or data["steps"]):
+        print(f"no telemetry artifacts found under {args.target} — expected "
+              "goodput-host*.json / costs-host*.json / forensics-host*.jsonl "
+              "(see docs/telemetry.md)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(data))
+    else:
+        print(format_report(data))
+    return 0
+
+
+def register(subparsers):
+    parser = subparsers.add_parser(
+        "report",
+        help="Explain a telemetry dir: goodput breakdown, per-executable "
+             "roofline rows, diagnosed recompiles",
+    )
+    parser.add_argument("target", help="telemetry dir (goodput/costs/forensics artifacts)")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.set_defaults(func=report_command)
+    return parser

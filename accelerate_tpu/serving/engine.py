@@ -302,13 +302,42 @@ class ServingEngine:
             raise RuntimeError("warmup() needs an idle engine")
         rng = jax.random.PRNGKey(0)
         jax.random.split(rng)  # the eager per-admission ops
+        if self.telemetry is not None:
+            from ..telemetry import forensics
+
+            # registration + the warmup fingerprints below establish the
+            # steady-state signatures, so any later diagnosed recompile
+            # names what the admission path changed
+            forensics.register(
+                "decode_step", donate=(1, 2, 3, 5) if self._donate else (),
+                statics={"num_slots": self.num_slots,
+                         "max_cache_len": self.max_cache_len,
+                         "temperature": self.temperature, "top_k": self.top_k},
+            )
+        costs = getattr(self.telemetry, "costs", None)
         for bucket in self.prefill_chunks:
+            warm_chunk = jnp.zeros((1, bucket), jnp.int32)
+            self._note_forensics(f"prefill_{bucket}", {"chunk_ids": warm_chunk})
             self._arena, _ = self._prefill_fn(bucket)(
-                self.params, self._arena, jnp.zeros((1, bucket), jnp.int32),
+                self.params, self._arena, warm_chunk,
                 0, 0, bucket - 1, rng,
             )
+            if costs is not None:
+                # roofline row per bucket; one re-trace, and the compiled
+                # memory analysis only when the persistent cache serves it
+                try:
+                    costs.capture_lowered(f"prefill_{bucket}", self._prefill_fn(bucket).lower(
+                        self.params, self._arena, warm_chunk, 0, 0, bucket - 1, rng,
+                    ))
+                except Exception:
+                    pass
         self._tokens, self._lengths, self._rngs = self._admit_state(
             self._tokens, self._lengths, self._rngs, 0, 0, 0, rng
+        )
+        self._note_forensics(
+            "decode_step",
+            {"tokens": self._tokens, "lengths": self._lengths,
+             "active": self._active, "rngs": self._rngs},
         )
         self._arena, self._tokens, self._lengths, self._rngs = self._decode_step(
             self.params, self._arena, self._tokens, self._lengths, self._active,
@@ -434,6 +463,16 @@ class ServingEngine:
             return None
         return getattr(self.telemetry, "requests", None)
 
+    def _note_forensics(self, fn: str, tree):
+        """Fingerprint one compiled-program dispatch for recompile
+        forensics; one attribute check when telemetry is off (the engine's
+        no-recompile invariant means a diagnosed cause here IS a bug)."""
+        if self.telemetry is None:
+            return
+        from ..telemetry import forensics
+
+        forensics.note_call(fn, tree)
+
     def _flight_dump(self, reason: str):
         flight = getattr(self.telemetry, "flight", None)
         if flight is not None:
@@ -479,14 +518,18 @@ class ServingEngine:
         seg = req.prompt[start:start + bucket]
         chunk[0, : seg.size] = seg
         last_idx = min(req.prompt.size, start + bucket) - 1 - start
+        chunk_dev = jnp.asarray(chunk)
+        self._note_forensics(f"prefill_{bucket}", {"chunk_ids": chunk_dev})
         t0 = time.perf_counter()
         self._arena, first = self._prefill_fn(bucket)(
-            self.params, self._arena, jnp.asarray(chunk), slot, start, last_idx,
+            self.params, self._arena, chunk_dev, slot, start, last_idx,
             prefill_rng,
         )
+        wall = time.perf_counter() - t0
         if tr is not None:
-            tr.on_prefill_chunk(req, slot, start, bucket, t0,
-                                time.perf_counter() - t0)
+            tr.on_prefill_chunk(req, slot, start, bucket, t0, wall)
+        if self.telemetry is not None and getattr(self.telemetry, "costs", None) is not None:
+            self.telemetry.costs.note_wall(f"prefill_{bucket}", wall)
         idx += 1
         if idx < len(plan):
             self._admitting[3] = idx
@@ -527,6 +570,11 @@ class ServingEngine:
         if not self._slot_req:
             return False
         k = self._burst_len()
+        self._note_forensics(
+            "decode_step" if k == 1 else f"decode_burst{k}",
+            {"tokens": self._tokens, "lengths": self._lengths,
+             "active": self._active, "rngs": self._rngs},
+        )
         t0 = time.perf_counter()
         if k > 1:
             self._arena, self._tokens, self._lengths, self._rngs, toks = (
@@ -562,6 +610,13 @@ class ServingEngine:
         self._step_samples.append((wall, emitted, k))
         if self.telemetry is not None:
             self.telemetry.on_step(self, wall, tokens=emitted, steps=k)
+            costs = getattr(self.telemetry, "costs", None)
+            if costs is not None:
+                # a fused burst is a lax.scan of k step BODIES, so its wall
+                # bills the captured decode_step program as k executions —
+                # the roofline row keeps accumulating in burst mode instead
+                # of splitting into an uncaptured decode_burst<k> row
+                costs.note_wall("decode_step", wall, calls=k)
         return True
 
     def _emit(self, req: Request, token: int, now: float):
@@ -626,6 +681,12 @@ class ServingEngine:
                 self.params, self._arena, self._tokens, self._lengths,
                 self._active, self._rngs,
             ).compile()
+            costs = getattr(self.telemetry, "costs", None)
+            if costs is not None:
+                # same AOT object feeds the roofline registry: the fused
+                # decode step is almost always the memory-bound poster
+                # child (per-token HBM traffic ~= whole KV arena + params)
+                costs.capture("decode_step", compiled)
             ma = compiled.memory_analysis()
             out = {}
             for key in ("argument_size_in_bytes", "output_size_in_bytes",

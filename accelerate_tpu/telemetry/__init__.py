@@ -38,6 +38,18 @@ runtime together and the engine feeds it automatically:
   recent events and dumps a debug bundle (in-flight requests, spans,
   memory, stacks) on unhandled exception, watchdog trip, or SIGTERM;
   trigger-based ``jax.profiler`` capture windows ride the same module.
+- **recompile forensics** — ``telemetry.forensics`` fingerprints the
+  abstract signature of every registered jitted entry point per call and
+  diffs it when the compile counters move, emitting the *cause* ("arg
+  batch['input_ids'] changed i32[8,128] -> i32[8,136]") as a JSONL record
+  plus a tagged span.
+- **goodput ledger + cost registry** — ``telemetry.goodput`` partitions
+  session wall into compute/compile/checkpoint/data-wait/stall/idle
+  (fractions sum to 1.0 in every rollup); ``telemetry.costs`` captures
+  ``cost_analysis``/``memory_analysis`` per executable at first compile
+  and classifies each against the device roofline, attributing measured
+  wall into per-fn model-MFU rows. ``accelerate-tpu report`` renders all
+  three offline.
 
 Everything is off unless a config is passed (or ``ATT_TELEMETRY=1``);
 when off, the engine's only cost is one ``is None`` check per step.
@@ -101,6 +113,11 @@ class TelemetryConfig:
     token_span_every: int = 0              # per-token decode spans for 1-in-N requests (0 = off)
     itl_series_max: int = 512              # ITL samples kept per request record
     exporter_port: Optional[int] = None    # Prometheus scrape thread (0 = ephemeral port)
+    # explanatory layer (docs/telemetry.md: goodput + roofline; the
+    # forensics JSONL needs trace_dir, the in-memory diffing does not)
+    forensics: bool = True             # recompile cause diffing + JSONL
+    goodput: bool = True               # wall-clock goodput ledger
+    cost_registry: bool = True         # per-executable roofline rows
     # flight recorder (docs/troubleshooting.md)
     flight_recorder: bool = True
     flight_events: int = 256               # bounded event ring capacity
@@ -201,6 +218,7 @@ class TelemetrySession:
         self._flops_fn = None
         self._wire_bytes: Optional[int] = None
         self._peak: Optional[float] = None
+        self._peak_bw: Optional[float] = None
         self._closed = False
 
         self.recorder: Optional[SpanRecorder] = None
@@ -229,6 +247,34 @@ class TelemetrySession:
 
         install_compile_listeners()
         self._compile_mark = compile_event_counters()
+
+        # the explanatory layer: goodput ledger, recompile forensics, and
+        # the per-executable cost registry (docs/telemetry.md)
+        self.goodput = None
+        if config.goodput:
+            from . import goodput as _goodput
+
+            self.goodput = _goodput.arm(_goodput.GoodputLedger())
+        self.forensics = None
+        if config.forensics:
+            from . import forensics as _forensics
+            from . import spans as _spans_mod
+
+            fpath = None
+            if self.trace_dir:
+                fpath = os.path.join(
+                    self.trace_dir, f"forensics-host{self.process_index}.jsonl"
+                )
+            self.forensics = _forensics.arm(_forensics.ForensicsRecorder(
+                fpath, self.process_index, span_recorder=_spans_mod.recorder,
+            ))
+        self.costs = None
+        if config.cost_registry:
+            from .costs import CostRegistry
+
+            self.costs = CostRegistry(
+                peak_flops_fn=self.peak_flops, peak_bw_fn=self.peak_hbm_bw,
+            )
 
         # SLO histograms + the request tracer (serving engines feed both)
         self.hists: dict = {}
@@ -361,6 +407,10 @@ class TelemetrySession:
     def _on_stall(self, report: str):
         """Watchdog trip: dump a flight-recorder bundle and (when a
         profiler window is configured) arm a capture for the next steps."""
+        if self.goodput is not None and self.watchdog is not None:
+            age = getattr(self.watchdog, "last_stall_age_s", None)
+            if age:
+                self.goodput.note_stall(age)
         if self.flight is not None:
             self.flight.note("watchdog_stall")
             self.flight.dump("watchdog_stall", extra={"stall_report": report})
@@ -421,14 +471,27 @@ class TelemetrySession:
             return
         loss = engine._pending_loss
         self.on_step(engine, wall, tokens=tokens or None, samples=samples or None,
-                     seq_len=seq_len, metrics={"loss": loss} if loss is not None else None)
+                     seq_len=seq_len, metrics={"loss": loss} if loss is not None else None,
+                     exe="train_fwd_bwd")
 
     def on_step(self, engine, wall_s: float, tokens=None, samples=None,
-                seq_len=None, steps: int = 1, metrics: Optional[dict] = None):
-        """Record one completed step (or one fused K-step dispatch)."""
+                seq_len=None, steps: int = 1, metrics: Optional[dict] = None,
+                exe: Optional[str] = None):
+        """Record one completed step (or one fused K-step dispatch).
+        ``exe`` names the executable that ran (``train_step``,
+        ``decode_step``, ...) so the cost registry can attribute the wall
+        to its roofline row."""
         step = engine.step_count
         data_wait, self._data_wait = self._data_wait, 0.0
         comp = self._drain_compile()
+        if self.goodput is not None:
+            self.goodput.on_step(wall_s, compile_s=comp.get("compile_s") or 0.0,
+                                 data_wait_s=data_wait)
+        if self.costs is not None and exe:
+            # one dispatch of the named executable — NOT `steps`: a fused
+            # K-step program's flops_per_call already covers the K steps,
+            # so billing K calls would inflate its model MFU K-fold
+            self.costs.note_wall(exe, wall_s)
         rec = {
             "step": step,
             "wall_s": float(wall_s),
@@ -550,6 +613,20 @@ class TelemetrySession:
                 self._peak = 200e12
         return self._peak
 
+    def peak_hbm_bw(self) -> float:
+        """Peak HBM bandwidth of device 0 (the roofline ridge's
+        denominator; conservative default when the probe fails)."""
+        if self._peak_bw is None:
+            from .costs import peak_hbm_bw
+
+            try:
+                import jax
+
+                self._peak_bw = peak_hbm_bw(jax.devices()[0])
+            except Exception:
+                self._peak_bw = 819e9
+        return self._peak_bw
+
     def rollup(self) -> dict:
         """Aggregate the rolling window plus the engine-state gauges into
         one flat dict of scalars (the ``log_system_metrics`` payload)."""
@@ -593,6 +670,16 @@ class TelemetrySession:
                 pass
         if self._wire_bytes is not None:
             out["sys/replica_wire_bytes_per_step"] = self._wire_bytes
+        if self.goodput is not None:
+            out.update(self.goodput.rollup_keys())
+        if self.costs is not None:
+            out.update(self.costs.rollup_keys())
+        if self.forensics is not None:
+            # no flush here: rollup() also runs on the Prometheus scrape
+            # thread, and finalizing the producer's pending event from
+            # there would stamp it with a partial compile delta. A pending
+            # event counts once its own thread (or close()) finalizes it.
+            out["sys/recompiles_diagnosed"] = len(self.forensics.recompiles())
         if self.config.device_memory:
             from .metrics import device_memory_stats
 
@@ -620,6 +707,13 @@ class TelemetrySession:
                 out.update(engine.metrics())  # host-side deque/counter math
             except Exception:
                 pass
+        if self.goodput is not None:
+            out.update(self.goodput.rollup_keys())
+        if self.costs is not None:
+            # probe=False: resolving the peak tables touches jax.devices(),
+            # and this path runs on the watchdog thread against a possibly
+            # wedged backend — use only already-resolved peaks
+            out.update(self.costs.rollup_keys(probe=False))
         return out
 
     def flush(self, step: Optional[int] = None) -> dict:
@@ -636,7 +730,23 @@ class TelemetrySession:
             acc.log(values, step=step)
         if self.flight is not None:
             self.flight.note_snapshot(values)
+        self._write_artifacts()
         return values
+
+    def _write_artifacts(self):
+        """Refresh the offline snapshots ``accelerate-tpu report`` reads
+        (cost registry + goodput ledger; forensics streams its own JSONL)."""
+        if not self.trace_dir:
+            return
+        try:
+            if self.costs is not None:
+                self.costs.write_snapshot(os.path.join(
+                    self.trace_dir, f"costs-host{self.process_index}.json"))
+            if self.goodput is not None:
+                self.goodput.write_snapshot(os.path.join(
+                    self.trace_dir, f"goodput-host{self.process_index}.json"))
+        except OSError:
+            pass
 
     def close(self):
         global _ACTIVE_SESSION
@@ -658,6 +768,19 @@ class TelemetrySession:
             self.exporter.close()
         if self.flight is not None:
             self.flight.uninstall_hooks()
+        self._write_artifacts()
+        if self.forensics is not None:
+            from . import forensics as _forensics
+
+            if _forensics.recorder() is self.forensics:
+                _forensics.disarm()
+            else:
+                self.forensics.close()
+        if self.goodput is not None:
+            from . import goodput as _goodput
+
+            if _goodput.ledger() is self.goodput:
+                _goodput.disarm()
         self.requests.close()
         if self.recorder is not None:
             from . import spans as _spans

@@ -191,7 +191,7 @@ class FlightRecorder:
         dir — the bundle still lands on stderr as a one-line summary)."""
         with self._lock:
             bundle = self.build_bundle(reason, extra)
-            self.dump_count += 1
+            n = self.dump_count + 1
             inflight = bundle.get("inflight_requests") or []
             print(
                 f"[accelerate_tpu flight-recorder] {reason}: "
@@ -201,12 +201,13 @@ class FlightRecorder:
                 file=sys.stderr,
             )
             if not self.dump_dir:
+                self.dump_count = n
                 return None
             try:
                 os.makedirs(self.dump_dir, exist_ok=True)
                 path = os.path.join(
                     self.dump_dir,
-                    f"flightrec-host{self.process_index}-{self.dump_count}.json",
+                    f"flightrec-host{self.process_index}-{n}.json",
                 )
                 with open(path, "w") as fh:
                     json.dump(bundle, fh, indent=1, default=str)
@@ -214,6 +215,11 @@ class FlightRecorder:
                 return path
             except OSError:
                 return None
+            finally:
+                # advance the counter only once last_bundle_path is set (or
+                # the write definitively failed): pollers on another thread
+                # key on dump_count to decide the bundle is readable
+                self.dump_count = n
 
 
 class CaptureWindow:

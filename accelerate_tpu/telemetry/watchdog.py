@@ -143,6 +143,7 @@ class HeartbeatWatchdog:
         self.n_spans = last_spans
         self.stall_count = 0
         self.last_report: Optional[str] = None
+        self.last_stall_age_s: Optional[float] = None  # goodput stall bucket
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._fired_for_step = None
@@ -210,6 +211,7 @@ class HeartbeatWatchdog:
             report = f"watchdog stall at step {step} (report build failed: {e!r})"
         self.stall_count += 1
         self.last_report = report
+        self.last_stall_age_s = float(age)
         print(report, file=sys.stderr)
         if self.dump_dir:
             try:

@@ -30,6 +30,69 @@ _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "accelerate_tpu", "xla_cache"
 )
 _enabled_dir: str | None = None
+_warned: set = set()
+
+
+def _warn_once(key: str, msg: str, *args):
+    """A disabled persistent cache means EVERY restart pays full
+    recompiles — a recurring silent regression. Name the cause once
+    instead of silently falling back."""
+    if key in _warned:
+        return
+    _warned.add(key)
+    import logging
+
+    logging.getLogger(__name__).warning(msg, *args)
+
+
+def _activate(cache_dir: str, set_thresholds: bool) -> str | None:
+    """Point jax at ``cache_dir``; warn-once (naming the resolved path)
+    and return None when the dir is unwritable or this jax build lacks
+    the compilation-cache config knobs."""
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError as e:
+        if not os.path.isdir(cache_dir):
+            _warn_once(
+                f"unusable:{cache_dir}",
+                "persistent XLA compile cache DISABLED: cache dir %s is not "
+                "usable (%s) — every process restart will recompile from "
+                "scratch. Point ATT_COMPILE_CACHE (or "
+                "JAX_COMPILATION_CACHE_DIR) at a writable path.",
+                cache_dir, e,
+            )
+            return None
+    if not os.access(cache_dir, os.W_OK):
+        # a read-only but populated dir (pre-baked image cache) still
+        # serves cache HITS — activate it, but say why misses won't stick
+        _warn_once(
+            f"readonly:{cache_dir}",
+            "persistent XLA compile cache dir %s is not writable: cached "
+            "executables will still be read, but NEW compiles cannot be "
+            "saved there — cache misses will recompile on every restart. "
+            "Point ATT_COMPILE_CACHE (or JAX_COMPILATION_CACHE_DIR) at a "
+            "writable path to persist them.",
+            cache_dir,
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        if set_thresholds:
+            # cache everything that takes noticeable time; entries are
+            # content-hashed
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except (AttributeError, KeyError, ValueError) as e:
+        _warn_once(
+            "no-config-knobs",
+            "persistent XLA compile cache DISABLED: this jax build (%s) "
+            "lacks the compilation-cache config knobs (%s); cache dir %s "
+            "will not be used and every restart recompiles.",
+            jax.__version__, e, cache_dir,
+        )
+        return None
+    return cache_dir
 
 
 def ensure_persistent_compile_cache(cache_dir: str | None = None) -> str | None:
@@ -59,23 +122,36 @@ def ensure_persistent_compile_cache(cache_dir: str | None = None) -> str | None:
             # and their thresholds. jax only reads JAX_COMPILATION_CACHE_DIR
             # at import, so re-apply it through jax.config (idempotent) in
             # case the env var was set after `import jax`.
-            user_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or jax.config.jax_compilation_cache_dir
+            user_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or getattr(
+                jax.config, "jax_compilation_cache_dir", None
+            )
             if user_dir:
-                os.makedirs(user_dir, exist_ok=True)
-                jax.config.update("jax_compilation_cache_dir", user_dir)
-                _enabled_dir = user_dir
+                # user-configured dir: keep their thresholds, only re-apply
+                # the dir (idempotent) in case the env var was set post-import
+                _enabled_dir = _activate(user_dir, set_thresholds=False)
                 return _enabled_dir
         cache_dir = env or _DEFAULT_DIR
     if _enabled_dir == cache_dir:
         return _enabled_dir
 
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    # cache everything that takes noticeable time; entries are content-hashed
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    _enabled_dir = cache_dir
+    _enabled_dir = _activate(cache_dir, set_thresholds=True)
     return _enabled_dir
+
+
+def active_cache_dir() -> str | None:
+    """The persistent cache dir jax is currently pointed at — ours or
+    user-configured — or None. Introspection for callers deciding whether
+    an AOT re-compile would be a cache deserialize or a cold backend
+    compile (NB: entries under the min-compile-time threshold are never
+    persisted, so an active dir is necessary but not sufficient)."""
+    if _enabled_dir:
+        return _enabled_dir
+    try:
+        import jax
+
+        return getattr(jax.config, "jax_compilation_cache_dir", None)
+    except Exception:
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -38,10 +38,12 @@ def phases_snapshot() -> dict:
 
 @contextmanager
 def phase(name: str):
+    from ..telemetry import goodput as _goodput
     from ..telemetry import spans as _spans
 
     rec = _spans.recorder()
-    if _ACTIVE is None and rec is None:
+    led = _goodput.ledger()
+    if _ACTIVE is None and rec is None and led is None:
         yield
         return
     t0 = time.perf_counter()
@@ -52,11 +54,21 @@ def phase(name: str):
         else:
             yield
     finally:
+        dt = time.perf_counter() - t0
         if _ACTIVE is not None:
-            _ACTIVE[name] = _ACTIVE.get(name, 0.0) + (time.perf_counter() - t0)
+            _ACTIVE[name] = _ACTIVE.get(name, 0.0) + dt
+        if led is not None:
+            # checkpoint/* phases feed the goodput ledger's checkpoint
+            # bucket; every other phase is covered by step wall or idle
+            led.note_phase(name, dt)
 
 
 def add_phase(name: str, seconds: float) -> None:
     """Record an externally-measured duration (e.g. a thread's wall time)."""
     if _ACTIVE is not None:
         _ACTIVE[name] = _ACTIVE.get(name, 0.0) + seconds
+    from ..telemetry import goodput as _goodput
+
+    led = _goodput.ledger()
+    if led is not None:
+        led.note_phase(name, seconds)

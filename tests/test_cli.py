@@ -414,6 +414,109 @@ class TestTraceCommand:
         assert r.returncode == 1 and "999" in r.stderr
 
 
+class TestReportCommand:
+    """`accelerate-tpu report` over the telemetry dir's explanatory
+    artifacts (goodput ledger, cost registry, forensics JSONL); as with
+    `trace`, the fixtures pin the on-disk formats the CLI must keep
+    reading — the real writers are covered in tests/test_telemetry.py."""
+
+    def _telemetry_dir(self, tmp_path):
+        (tmp_path / "goodput-host0.json").write_text(json.dumps({
+            "elapsed_s": 100.0,
+            "seconds": {"compute": 62.0, "compile": 20.0, "checkpoint": 5.0,
+                        "data_wait": 3.0, "stall": 0.0, "idle": 10.0},
+            "fractions": {"compute": 0.62, "compile": 0.2, "checkpoint": 0.05,
+                          "data_wait": 0.03, "stall": 0.0, "idle": 0.1},
+        }))
+        (tmp_path / "costs-host0.json").write_text(json.dumps({
+            "peak_flops": 197e12, "peak_hbm_bw": 819e9,
+            "ridge_intensity": 240.5,
+            "executables": [
+                {"name": "train_step", "flops_per_call": 5e13,
+                 "hbm_bytes_per_call": 1e11, "arith_intensity": 500.0,
+                 "ridge_intensity": 240.5, "roofline": "compute-bound",
+                 "wall_s": 80.0, "calls": 160},
+                {"name": "decode_step", "flops_per_call": 1e9,
+                 "hbm_bytes_per_call": 1e9, "arith_intensity": 1.0,
+                 "ridge_intensity": 240.5, "roofline": "memory-bound",
+                 "wall_s": 10.0, "calls": 5000},
+            ],
+        }))
+        forens = [
+            {"fn": "train_step", "event": "first_compile",
+             "time_unix_s": 100.0, "causes": [],
+             "cause": "train_step: first compile of this entry point",
+             "compile_events": 4, "compile_s": 30.0, "compile_cache_hits": 0},
+            {"fn": "train_step", "event": "recompile", "time_unix_s": 163.0,
+             "causes": [{"arg": "batch['input_ids']", "kind": "shape",
+                         "before": "i32[8,128]", "after": "i32[8,136]"}],
+             "cause": "train_step recompiled: arg batch['input_ids'] "
+                      "changed i32[8,128] -> i32[8,136]",
+             "compile_events": 1, "compile_s": 12.5, "compile_cache_hits": 0},
+        ]
+        (tmp_path / "forensics-host0.jsonl").write_text(
+            "\n".join(json.dumps(r) for r in forens) + "\n"
+        )
+        (tmp_path / "metrics-host0.jsonl").write_text(
+            "\n".join(json.dumps({"step": i + 1, "wall_s": 0.5, "steps": 1,
+                                  "tokens": 16384,
+                                  "compile_events": 1 if i == 3 else 0})
+                      for i in range(4)) + "\n"
+        )
+        return tmp_path
+
+    def test_report_renders_goodput_roofline_and_recompiles(self, tmp_path):
+        d = self._telemetry_dir(tmp_path)
+        r = _run(["report", str(d)])
+        assert r.returncode == 0, r.stderr
+        out = r.stdout
+        # goodput breakdown with fractions summing to 1.0
+        assert "goodput breakdown" in out and "fractions sum to 1.00" in out
+        assert "compute" in out and "62.0%" in out
+        assert "goodput (productive compute) = 62.0%" in out
+        # roofline table: both classes present, model MFU derived from the
+        # merged wall (5e13 * 160 / 80 / 197e12 = 50.8%)
+        assert "compute-bound" in out and "memory-bound" in out
+        assert "50.76%" in out
+        # the recompile line names the argument and the aval change
+        assert ("train_step recompiled: arg batch['input_ids'] changed "
+                "i32[8,128] -> i32[8,136]") in out
+        assert "compile 12.50s" in out
+        assert "4 recorded" in out  # step aggregate
+
+    def test_report_json_machine_readable(self, tmp_path):
+        d = self._telemetry_dir(tmp_path)
+        r = _run(["report", str(d), "--json"])
+        assert r.returncode == 0, r.stderr
+        data = json.loads(r.stdout)
+        assert sum(data["goodput"]["fractions"].values()) == pytest.approx(1.0)
+        rows = {x["name"]: x for x in data["costs"]["executables"]}
+        assert rows["train_step"]["roofline"] == "compute-bound"
+        assert rows["train_step"]["mfu_model_pct"] == pytest.approx(50.76, abs=0.01)
+        assert rows["decode_step"]["roofline"] == "memory-bound"
+        assert len(data["recompiles"]) == 1
+        assert data["recompiles"][0]["causes"][0]["arg"] == "batch['input_ids']"
+
+    def test_multi_host_goodput_merges(self, tmp_path):
+        d = self._telemetry_dir(tmp_path)
+        # a second, idle host dilutes fleet goodput — the point of the merge
+        (tmp_path / "goodput-host1.json").write_text(json.dumps({
+            "elapsed_s": 100.0,
+            "seconds": {"compute": 0.0, "compile": 0.0, "checkpoint": 0.0,
+                        "data_wait": 0.0, "stall": 0.0, "idle": 100.0},
+            "fractions": {"compute": 0.0, "compile": 0.0, "checkpoint": 0.0,
+                          "data_wait": 0.0, "stall": 0.0, "idle": 1.0},
+        }))
+        r = _run(["report", str(d), "--json"])
+        data = json.loads(r.stdout)
+        assert data["goodput"]["fractions"]["compute"] == pytest.approx(0.31)
+        assert sum(data["goodput"]["fractions"].values()) == pytest.approx(1.0)
+
+    def test_report_empty_dir_fails_cleanly(self, tmp_path):
+        r = _run(["report", str(tmp_path)])
+        assert r.returncode == 1 and "no telemetry artifacts" in r.stderr
+
+
 class TestConfigMenu:
     """The arrow-key BulletMenu (reference commands/menu/ parity) and its
     non-TTY fallback used by `accelerate-tpu config`."""

@@ -64,6 +64,36 @@ def test_user_jax_cache_dir_respected_and_applied(cache_state):
     assert os.path.isdir(user)
 
 
+def test_unusable_dir_warns_once_and_disables(cache_state, caplog):
+    """A cache dir that cannot be created must disable the cache with ONE
+    warning naming the resolved path — the silent-fallback recurrence was
+    every restart paying full recompiles with nothing in the logs."""
+    import logging
+
+    monkeypatch, tmp_path = cache_state
+    blocker = tmp_path / "a_file"
+    blocker.write_text("not a dir")
+    target = str(blocker / "cache")  # parent is a regular file
+    cc._enabled_dir = None
+    cc._warned.discard(f"unusable:{target}")
+    monkeypatch.setenv("ATT_COMPILE_CACHE", target)
+    with caplog.at_level(logging.WARNING, logger="accelerate_tpu.utils.compile_cache"):
+        assert cc.ensure_persistent_compile_cache() is None
+        assert cc.ensure_persistent_compile_cache() is None  # idempotent
+    hits = [r for r in caplog.records if "DISABLED" in r.getMessage()]
+    assert len(hits) == 1  # once, not per call
+    assert target in hits[0].getMessage()
+
+
+def test_active_cache_dir_reports_enabled_dir(cache_state):
+    monkeypatch, tmp_path = cache_state
+    cc._enabled_dir = None
+    target = str(tmp_path / "active")
+    monkeypatch.setenv("ATT_COMPILE_CACHE", target)
+    assert cc.ensure_persistent_compile_cache() == target
+    assert cc.active_cache_dir() == target
+
+
 def test_self_set_dir_not_misread_as_user_config(cache_state):
     """After we enable the default dir, later no-arg calls must hit the
     idempotent early-return, not re-classify our own dir as user config

@@ -55,3 +55,25 @@ class TestNoEagerHeavyImports:
             "import accelerate_tpu.commands.trace\n"
             "assert 'jax' not in sys.modules, 'trace CLI pulled jax'"
         )
+
+    def test_explanatory_layer_stays_light(self):
+        """The goodput ledger, recompile forensics, and cost registry are
+        host-side bookkeeping (signature walks, dict math, JSON) — jax
+        loads only when a session actually probes a device."""
+        _probe(
+            "import sys\n"
+            "import accelerate_tpu.telemetry.forensics\n"
+            "import accelerate_tpu.telemetry.goodput\n"
+            "import accelerate_tpu.telemetry.costs\n"
+            "heavy = {m for m in ('jax', 'flax') if m in sys.modules}\n"
+            "assert not heavy, f'explanatory-telemetry import pulled {heavy}'"
+        )
+
+    def test_report_cli_module_stays_light(self):
+        """`accelerate-tpu report` renders goodput/roofline/forensics
+        artifacts on log-only machines — no jax at import."""
+        _probe(
+            "import sys\n"
+            "import accelerate_tpu.commands.report\n"
+            "assert 'jax' not in sys.modules, 'report CLI pulled jax'"
+        )

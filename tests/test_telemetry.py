@@ -480,6 +480,215 @@ class TestStreamingHistogram:
         assert out["serving/ttft_p99_ms"] == pytest.approx(100, rel=0.13)
 
 
+class TestRecompileForensics:
+    """Signature-diff cause detection: shape, dtype, new-static-arg — and
+    the compile-counter attribution that rides each diagnosed record."""
+
+    def _rec(self, tmp_path=None):
+        from accelerate_tpu.telemetry.forensics import ForensicsRecorder
+
+        path = str(tmp_path / "forensics.jsonl") if tmp_path is not None else None
+        return ForensicsRecorder(path)
+
+    def test_shape_change_names_argument_and_avals(self):
+        rec = self._rec()
+        first = rec.note_call("train_step", {"batch": {"input_ids": np.zeros((8, 128), np.int32)}})
+        assert first["event"] == "first_compile"
+        assert rec.note_call(  # same signature: fast path, no event
+            "train_step", {"batch": {"input_ids": np.zeros((8, 128), np.int32)}}
+        ) is None
+        evt = rec.note_call("train_step", {"batch": {"input_ids": np.zeros((8, 136), np.int32)}})
+        assert evt["event"] == "recompile"
+        (cause,) = evt["causes"]
+        assert cause["kind"] == "shape"
+        assert cause["arg"] == "batch['input_ids']"
+        assert (cause["before"], cause["after"]) == ("i32[8,128]", "i32[8,136]")
+        assert "batch['input_ids'] changed i32[8,128] -> i32[8,136]" in evt["cause"]
+        rec.close()
+
+    def test_dtype_change_detected(self):
+        rec = self._rec()
+        rec.note_call("eval_fwd", {"x": np.zeros((4,), np.float32)})
+        evt = rec.note_call("eval_fwd", {"x": np.zeros((4,), np.float16)})
+        assert evt["causes"][0]["kind"] == "dtype"
+        assert "f32[4] -> f16[4]" in evt["cause"]
+        rec.close()
+
+    def test_new_static_arg_detected(self):
+        rec = self._rec()
+        rec.note_call("fwd", {"ids": np.zeros((2, 8), np.int32)})
+        evt = rec.note_call(
+            "fwd", {"ids": np.zeros((2, 8), np.int32), "deterministic": False}
+        )
+        (cause,) = evt["causes"]
+        assert cause["kind"] == "new_static" and cause["arg"] == "deterministic"
+        assert "arg deterministic is new (static:False)" in evt["cause"]
+        # flipping the static is a `static` cause, not a new arg
+        evt2 = rec.note_call(
+            "fwd", {"ids": np.zeros((2, 8), np.int32), "deterministic": True}
+        )
+        assert evt2["causes"][0]["kind"] == "static"
+        rec.close()
+
+    def test_compile_delta_attributed_and_jsonl_written(self, tmp_path):
+        from accelerate_tpu.utils.compile_cache import record_compile_event
+
+        rec = self._rec(tmp_path)
+        rec.note_call("step", {"x": np.zeros((4,), np.float32)})
+        record_compile_event(1.25)  # the compile the dispatch paid
+        record_compile_event(cache_hit=True)
+        rec.note_call("step", {"x": np.zeros((6,), np.float32)})  # finalizes pending
+        rec.flush()
+        recs = [json.loads(l) for l in open(tmp_path / "forensics.jsonl")]
+        assert [r["event"] for r in recs] == ["first_compile", "recompile"]
+        assert recs[0]["compile_events"] == 1
+        assert recs[0]["compile_s"] == pytest.approx(1.25)
+        assert recs[0]["compile_cache_hits"] == 1
+        assert recs[1]["causes"][0]["before"] == "f32[4]"
+        rec.close()
+
+    def test_module_level_noop_when_disarmed(self):
+        from accelerate_tpu.telemetry import forensics
+
+        forensics.note_call("anything", {"x": np.zeros((2,))})  # must not raise
+        assert forensics.recorder() is None
+
+
+class TestGoodputLedger:
+    def test_fractions_sum_to_one_under_synthetic_session(self):
+        from accelerate_tpu.telemetry.goodput import GoodputLedger
+
+        now = [0.0]
+        led = GoodputLedger(clock=lambda: now[0])
+        # 10s of session wall: 6 compute-ish steps + checkpoint + stall
+        for _ in range(6):
+            led.on_step(wall_s=1.0, compile_s=0.2, data_wait_s=0.1)
+        led.note_phase("checkpoint/save", 1.5)
+        led.note_phase("dispatch_total", 9.0)  # non-checkpoint phase: ignored
+        led.note_stall(0.5)
+        now[0] = 10.0
+        fr = led.fractions()
+        assert sum(fr.values()) == pytest.approx(1.0)
+        assert fr["compute"] == pytest.approx(0.42)   # 6 * (1.0 - 0.3) / 10
+        assert fr["compile"] == pytest.approx(0.12)
+        assert fr["data_wait"] == pytest.approx(0.06)
+        assert fr["checkpoint"] == pytest.approx(0.15)
+        assert fr["stall"] == pytest.approx(0.05)
+        assert fr["idle"] == pytest.approx(0.20)
+        keys = led.rollup_keys()
+        assert keys["goodput/goodput_frac"] == pytest.approx(0.42)
+        assert sum(keys[f"goodput/{b}_frac"]
+                   for b in ("compute", "compile", "checkpoint", "data_wait",
+                             "stall", "idle")) == pytest.approx(1.0, abs=0.01)
+
+    def test_overlapping_instrumentation_renormalizes(self):
+        from accelerate_tpu.telemetry.goodput import GoodputLedger
+
+        now = [0.0]
+        led = GoodputLedger(clock=lambda: now[0])
+        led.on_step(wall_s=8.0)
+        led.note_stall(4.0)  # stall interval later covered by the step wall
+        now[0] = 10.0
+        fr = led.fractions()
+        assert sum(fr.values()) == pytest.approx(1.0)
+
+    def test_compute_clamps_when_compile_exceeds_wall(self):
+        from accelerate_tpu.telemetry.goodput import GoodputLedger
+
+        led = GoodputLedger()
+        led.on_step(wall_s=0.5, compile_s=2.0)  # other-thread compile billed here
+        t = led.totals()
+        assert t["compute"] == 0.0 and t["compile"] == pytest.approx(2.0)
+
+    def test_checkpoint_phase_feeds_armed_ledger(self):
+        from accelerate_tpu.telemetry import goodput
+        from accelerate_tpu.utils import phases
+
+        led = goodput.arm(goodput.GoodputLedger())
+        try:
+            with phases.phase("checkpoint/save"):
+                time.sleep(0.01)
+            assert led.totals()["checkpoint"] >= 0.01
+        finally:
+            goodput.disarm()
+        assert goodput.ledger() is None
+
+
+class TestCostRegistry:
+    class _Compiled:
+        """Duck-typed stand-in for a jax Compiled (cost/memory analysis)."""
+
+        def __init__(self, flops, hbm, temp=1024):
+            self._flops, self._hbm, self._temp = flops, hbm, temp
+
+        def cost_analysis(self):
+            return [{"flops": self._flops, "bytes accessed": self._hbm}]
+
+        def memory_analysis(self):
+            class MA:
+                argument_size_in_bytes = 100
+                output_size_in_bytes = 50
+                temp_size_in_bytes = self._temp
+                generated_code_size_in_bytes = 10
+            return MA()
+
+    def test_classification_on_matmul_heavy_and_gather_heavy_jitted_fns(self):
+        """The real thing: XLA's own cost_analysis on a matmul-heavy vs a
+        gather-heavy jitted fn must land on opposite sides of an explicit
+        roofline ridge."""
+        from accelerate_tpu.telemetry.costs import CostRegistry
+
+        reg = CostRegistry(peak_flops=1e12, peak_bw=1e11)  # ridge = 10
+        mm = jax.jit(lambda a, b: a @ b).lower(
+            jnp.zeros((256, 256)), jnp.zeros((256, 256))
+        ).compile()
+        row_mm = reg.capture("matmul_step", mm)
+        ga = jax.jit(lambda t, i: t[i]).lower(
+            jnp.zeros((4096, 64)), jnp.zeros((512,), jnp.int32)
+        ).compile()
+        row_ga = reg.capture("gather_step", ga)
+        assert row_mm["roofline"] == "compute-bound"
+        assert row_ga["roofline"] == "memory-bound"
+        assert row_mm["arith_intensity"] > 10 > row_ga["arith_intensity"]
+
+    def test_wall_attribution_and_model_mfu(self):
+        from accelerate_tpu.telemetry.costs import CostRegistry
+
+        reg = CostRegistry(peak_flops=1e12, peak_bw=1e11)
+        reg.capture("step", self._Compiled(flops=1e9, hbm=1e7))
+        for _ in range(10):
+            reg.note_wall("step", 0.01)
+        (row,) = reg.rows()
+        # 1e9 flops * 10 calls / 0.1s / 1e12 peak = 10% model MFU
+        assert row["mfu_model_pct"] == pytest.approx(10.0)
+        assert row["bw_util_pct"] == pytest.approx(1.0)
+        assert row["roofline"] == "compute-bound"  # AI 100 vs ridge 10
+        keys = reg.rollup_keys()
+        assert keys["exe/step_mfu_model_pct"] == pytest.approx(10.0)
+        assert keys["exe/step_compute_bound"] is True
+        assert keys["exe/step_calls"] == 10
+
+    def test_capture_survives_backends_without_cost_analysis(self):
+        from accelerate_tpu.telemetry.costs import CostRegistry
+
+        class Broken:
+            def cost_analysis(self):
+                raise NotImplementedError
+
+        reg = CostRegistry()
+        assert reg.capture("x", Broken()) is None
+        reg.note_wall("only_wall", 0.5)  # wall without costs still rows
+        (row,) = reg.rows()
+        assert row["name"] == "only_wall" and "mfu_model_pct" not in row
+
+    def test_peak_hbm_bw_table_prefers_most_specific_kind(self):
+        from accelerate_tpu.telemetry.costs import peak_hbm_bw
+
+        assert peak_hbm_bw(types.SimpleNamespace(device_kind="TPU v5 lite")) == 819e9
+        assert peak_hbm_bw(types.SimpleNamespace(device_kind="TPU v5p")) == 2.765e12
+        assert peak_hbm_bw(types.SimpleNamespace(device_kind="cpu")) == 819e9
+
+
 class TestDeviceMemoryStats:
     def test_tolerates_none_partial_and_tracks_peak_deltas(self):
         from accelerate_tpu.telemetry import metrics as metrics_mod
@@ -735,19 +944,37 @@ class TestEngineIntegration:
         batch = acc.prepare_for_eval({"input_ids": ids, "labels": ids})
         for _ in range(3):
             step(batch)
+        # deliberately shape-varied step: forensics must diagnose the
+        # recompile it pays, naming the argument and the aval change
+        ids_v = np.random.RandomState(1).randint(0, cfg.vocab_size, (8, 24))
+        step(acc.prepare_for_eval({"input_ids": ids_v, "labels": ids_v}))
 
         values = acc.log_system_metrics()
         for key in ("sys/step_time_s", "sys/tokens_per_s", "sys/mfu_pct",
                     "sys/loss", "sys/grad_norm", "sys/step"):
             assert key in values, key
-        assert values["sys/step"] == 3
+        assert values["sys/step"] == 4
         assert values["sys/tokens_per_s"] > 0
+
+        # goodput ledger: every bucket present, fractions sum to ~1.0
+        from accelerate_tpu.telemetry.goodput import BUCKETS
+
+        fracs = [values[f"goodput/{b}_frac"] for b in BUCKETS]
+        assert sum(fracs) == pytest.approx(1.0, abs=0.02)
+        assert values["goodput/compile_frac"] > 0  # this run compiled
+        # cost registry: the train-step executable has a roofline row
+        assert values["exe/train_step_calls"] == 4
+        assert values["exe/train_step_wall_s"] > 0
+        assert "exe/train_step_arith_intensity" in values
+        # forensics: the shape-varied recompile is diagnosed immediately
+        # (still pending compile-delta attribution until finalized)
+        assert values["sys/recompiles_diagnosed"] == 1
 
         # heartbeat published through the shared-dict state
         from accelerate_tpu.state import PartialState
 
         hb = PartialState().heartbeat
-        assert hb is not None and hb[0] == 3
+        assert hb is not None and hb[0] == 4
 
         acc.end_training()
 
@@ -755,8 +982,8 @@ class TestEngineIntegration:
         tracked = [json.loads(l) for l in open(tmp_path / "run" / "metrics.jsonl")]
         assert any("sys/tokens_per_s" in rec["values"] for rec in tracked)
         per_step = [json.loads(l) for l in open(tel_dir / "metrics-host0.jsonl")]
-        assert [r["step"] for r in per_step] == [1, 2, 3]
-        for rec in per_step:
+        assert [r["step"] for r in per_step] == [1, 2, 3, 4]
+        for rec in per_step[:3]:
             assert rec["tokens"] == 8 * 16
             assert "tokens_per_s" in rec and "mfu_pct" in rec and "wall_s" in rec
 
@@ -764,8 +991,22 @@ class TestEngineIntegration:
         trace = spans_mod.load_chrome_trace(str(tel_dir / "trace-host0.jsonl"))
         steps_in_trace = [e for e in trace["traceEvents"]
                           if e.get("name") == "engine/train_step"]
-        assert len(steps_in_trace) == 3
+        assert len(steps_in_trace) == 4
         assert all(e["ph"] == "X" and e["dur"] > 0 for e in steps_in_trace)
+
+        # (c) the offline artifacts the report CLI reads landed at close
+        gp = json.load(open(tel_dir / "goodput-host0.json"))
+        assert sum(gp["fractions"].values()) == pytest.approx(1.0, abs=0.02)
+        costs = json.load(open(tel_dir / "costs-host0.json"))
+        names = [r["name"] for r in costs["executables"]]
+        assert "train_step" in names
+        # the recompile record finalized at close with its compile delta
+        forens = [json.loads(l) for l in open(tel_dir / "forensics-host0.jsonl")]
+        recompiles = [r for r in forens if r["event"] == "recompile"]
+        assert len(recompiles) == 1
+        assert "batch['input_ids'] changed" in recompiles[0]["cause"]
+        assert "[8,16]" in recompiles[0]["cause"] and "[8,24]" in recompiles[0]["cause"]
+        assert recompiles[0]["compile_events"] > 0
 
     def test_disabled_by_default_and_hooks_dormant(self):
         from accelerate_tpu import Accelerator
