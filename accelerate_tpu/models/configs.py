@@ -67,6 +67,15 @@ class DecoderConfig:
     pipeline_schedule: str = "gpipe"
     # KV-cache length for generation (None -> max_seq_len)
     max_cache_len: Optional[int] = None
+    # paged KV cache (serving/pages.py): when both are set, decode-time
+    # cache leaves are [kv_num_pages, KVH, kv_page_size, D] physical pages
+    # addressed through a per-slot page table instead of a dense
+    # [B, KVH, max_cache_len, D] arena — the slot's KV footprint tracks its
+    # actual length, and pages can be shared copy-on-write across slots
+    # (prefix cache). Only the slot-arena decode path supports paging;
+    # prefill runs against dense per-slot gather views the engine builds.
+    kv_page_size: Optional[int] = None   # tokens per page, power of two
+    kv_num_pages: Optional[int] = None   # physical pages in the arena
     # fp8 recipe (ops/fp8.py): every Linear-equivalent contraction (QKV/O + MLP) runs e4m3-fwd/e5m2-bwd.
     # Flipped on by Accelerator(mixed_precision="fp8"). ``fp8_recipe``:
     # "current" (per-tensor amax each step, XLA fuses the reduction) or
@@ -129,6 +138,17 @@ class DecoderConfig:
                 f"pipeline_schedule must be 'gpipe' or '1f1b', got "
                 f"{self.pipeline_schedule!r}"
             )
+        if (self.kv_page_size is None) != (self.kv_num_pages is None):
+            raise ValueError(
+                "kv_page_size and kv_num_pages must be set together "
+                f"(got page_size={self.kv_page_size}, num_pages={self.kv_num_pages})"
+            )
+        if self.kv_page_size is not None:
+            ps = self.kv_page_size
+            if ps < 1 or (ps & (ps - 1)) != 0:
+                raise ValueError(f"kv_page_size must be a power of two, got {ps}")
+            if self.kv_num_pages < 1:
+                raise ValueError(f"kv_num_pages must be >= 1, got {self.kv_num_pages}")
         if self.moe_num_experts == 1:
             raise ValueError("moe_num_experts must be 0 (dense) or >= 2")
         if self.moe_num_experts > 1 and not (1 <= self.moe_top_k <= self.moe_num_experts):

@@ -150,11 +150,24 @@ class DecoderAttention(nn.Module):
     cache is [B, KVH, max_cache_len, D] — static shapes, so the whole decode
     loop compiles once.
 
-    ``cache_positions`` ([B] int32, decode-only) switches the cache to
-    slot-arena semantics (``serving/``): each batch row is an independent
-    request whose new K/V lands at its OWN offset and whose attention sees
-    only its own prefix — admission/eviction become pure data changes with
-    no shape change and no recompile.
+    ``cache_positions`` ([B] or [B, S] int32, decode-only) switches the
+    cache to slot-arena semantics (``serving/``): each batch row is an
+    independent request whose new K/V lands at its OWN offset(s) and whose
+    attention sees only its own prefix — admission/eviction become pure
+    data changes with no shape change and no recompile. The [B, S] form is
+    the speculative-verify step: S tokens per slot land at per-token
+    positions and each query attends ``<= its own position`` (so draft
+    token i sees drafts 0..i written in the same call — exactly the
+    incremental-decode semantics, batched).
+
+    ``page_table`` ([B, P] int32, with ``config.kv_page_size`` /
+    ``kv_num_pages`` set) switches the cache storage to physical pages
+    (``serving/pages.py``): leaves are [num_pages, KVH, page_size, D], the
+    scatter routes each position through its slot's table entry, and the
+    read gathers pages back into position order
+    (``ops/attention.paged_decode_attention``). Sharing one physical page
+    across slots' tables is copy-on-write prefix sharing; the serving
+    engine forks pages before divergent writes.
 
     ``causal=False`` (+ optional ``kv_mask``) is the bidirectional form the
     seq2seq encoder reuses (models/seq2seq.py) — same projections, RoPE and
@@ -170,7 +183,7 @@ class DecoderAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, sin, cos, deterministic: bool = True, kv_mask=None,
-                 cache_positions=None):
+                 cache_positions=None, page_table=None):
         cfg = self.config
         e, h, kv, d = cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
         b, s = x.shape[0], x.shape[1]
@@ -197,11 +210,28 @@ class DecoderAttention(nn.Module):
         k = apply_rotary_embedding(k, sin, cos)
 
         if self.use_cache:
+            # getattr: Seq2SeqConfig reuses this module and has no paging knobs
+            paged = getattr(cfg, "kv_page_size", None) is not None
             max_len = cfg.max_cache_len or cfg.max_seq_len
-            cached_k = self.variable("cache", "cached_key", jnp.zeros, (b, kv, max_len, d), k.dtype)
-            cached_v = self.variable("cache", "cached_value", jnp.zeros, (b, kv, max_len, d), v.dtype)
+            if paged:
+                cached_k = self.variable(
+                    "cache", "cached_key", jnp.zeros,
+                    (cfg.kv_num_pages, kv, cfg.kv_page_size, d), k.dtype)
+                cached_v = self.variable(
+                    "cache", "cached_value", jnp.zeros,
+                    (cfg.kv_num_pages, kv, cfg.kv_page_size, d), v.dtype)
+            else:
+                cached_k = self.variable("cache", "cached_key", jnp.zeros, (b, kv, max_len, d), k.dtype)
+                cached_v = self.variable("cache", "cached_value", jnp.zeros, (b, kv, max_len, d), v.dtype)
             cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
             cur = cache_index.value
+            if paged and (not self.decode or cache_positions is None or page_table is None):
+                raise NotImplementedError(
+                    "a paged KV cache (config.kv_page_size) supports only "
+                    "slot-arena decode (decode=True with cache_positions "
+                    "and page_table); prefill runs against dense per-slot "
+                    "gather views built by serving/pages.py"
+                )
             if not self.decode:
                 # prefill: cache starts at 0, so plain causal attention over
                 # the freshly computed K/V stays on the flash-kernel path
@@ -211,27 +241,46 @@ class DecoderAttention(nn.Module):
                 out = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
             elif cache_positions is not None:
                 # slot-arena decode (serving/): every batch row writes its
-                # one new K/V at its own per-slot offset and attends only
+                # new K/V at its own per-slot offset(s) and attends only
                 # its own prefix. Stale entries past a slot's frontier
-                # (previous occupant, bucketed-prefill padding) are always
-                # overwritten at the write position BEFORE being attended,
-                # so slot reuse needs no cache clearing.
-                if s != 1:
-                    raise NotImplementedError(
-                        "cache_positions (slot-arena decode) expects one "
-                        "token per slot; chunked prefill runs per-slot via "
-                        "the scalar cache_index path"
-                    )
-                from ..ops.attention import decode_attention
-
-                rows = jnp.arange(b)
-                k_full = cached_k.value.at[rows, :, cache_positions].set(k[:, :, 0])
-                v_full = cached_v.value.at[rows, :, cache_positions].set(v[:, :, 0])
-                cached_k.value = k_full
-                cached_v.value = v_full
-                out = decode_attention(
-                    q, k_full, v_full, q_positions=cache_positions[:, None]
+                # (previous occupant, bucketed-prefill padding, rolled-back
+                # speculative drafts) are always overwritten at the write
+                # position BEFORE being attended, so neither slot reuse nor
+                # speculative rollback needs any cache clearing.
+                pos2d = (
+                    cache_positions[:, None]
+                    if cache_positions.ndim == 1 else cache_positions
                 )
+                if pos2d.shape[1] != s:
+                    raise ValueError(
+                        f"cache_positions covers {pos2d.shape[1]} positions "
+                        f"per slot but {s} tokens were fed"
+                    )
+                rows = jnp.arange(b)
+                kv_new = jnp.swapaxes(k, 1, 2)  # [B, S, KVH, D]
+                vv_new = jnp.swapaxes(v, 1, 2)
+                if paged:
+                    from ..ops.attention import paged_decode_attention
+
+                    ps = cfg.kv_page_size
+                    page = page_table[rows[:, None], pos2d // ps]  # [B, S]
+                    off = pos2d % ps
+                    k_pages = cached_k.value.at[page, :, off].set(kv_new)
+                    v_pages = cached_v.value.at[page, :, off].set(vv_new)
+                    cached_k.value = k_pages
+                    cached_v.value = v_pages
+                    out = paged_decode_attention(
+                        q, k_pages, v_pages,
+                        page_table=page_table, q_positions=pos2d,
+                    )
+                else:
+                    from ..ops.attention import decode_attention
+
+                    k_full = cached_k.value.at[rows[:, None], :, pos2d].set(kv_new)
+                    v_full = cached_v.value.at[rows[:, None], :, pos2d].set(vv_new)
+                    cached_k.value = k_full
+                    cached_v.value = v_full
+                    out = decode_attention(q, k_full, v_full, q_positions=pos2d)
             else:
                 k_full = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
                 v_full = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
@@ -295,13 +344,15 @@ class DecoderBlock(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, sin, cos, deterministic: bool = True, cache_positions=None):
+    def __call__(self, x, sin, cos, deterministic: bool = True, cache_positions=None,
+                 page_table=None):
         cfg = self.config
         ln1 = self.param("ln_attn", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         ln2 = self.param("ln_mlp", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         y = rms_norm(x, ln1, cfg.norm_eps)
         y = DecoderAttention(cfg, self.mesh, self.use_cache, self.decode, name="attn")(
-            y, sin, cos, deterministic, cache_positions=cache_positions
+            y, sin, cos, deterministic, cache_positions=cache_positions,
+            page_table=page_table,
         )
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
@@ -333,13 +384,13 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        # cpos rides the carry like sin/cos (a broadcast input every layer
-        # reads unchanged); None when the slot-arena path is off
-        x, aux, sin, cos, cpos = carry
+        # cpos/ptab ride the carry like sin/cos (broadcast inputs every
+        # layer reads unchanged); None when the slot-arena path is off
+        x, aux, sin, cos, cpos, ptab = carry
         x, block_aux = DecoderBlock(self.config, self.mesh, self.use_cache, self.decode, name="block")(
-            x, sin, cos, self.deterministic, cache_positions=cpos
+            x, sin, cos, self.deterministic, cache_positions=cpos, page_table=ptab
         )
-        return (x, aux + block_aux, sin, cos, cpos), None
+        return (x, aux + block_aux, sin, cos, cpos, ptab), None
 
 
 class StageStack(nn.Module):
@@ -362,9 +413,9 @@ class StageStack(nn.Module):
             length=cfg.num_layers // cfg.pipeline_stages,
             metadata_params={nn.PARTITION_NAME: "layer"},
         )
-        (x, aux, _, _, _), _ = Stack(
+        (x, aux, _, _, _, _), _ = Stack(
             cfg, self.mesh, deterministic=deterministic, name="layers"
-        )((x, jnp.float32(0.0), sin, cos, None), None)
+        )((x, jnp.float32(0.0), sin, cos, None, None), None)
         if cfg.moe_num_experts > 1:
             # per-(stage, microbatch) router load-balance sum over this
             # stage's layers; the schedule accumulates and renormalizes
@@ -392,6 +443,7 @@ class DecoderLM(nn.Module):
         use_cache: bool = False,
         decode: bool = False,
         cache_positions: Optional[jax.Array] = None,
+        page_table: Optional[jax.Array] = None,
     ):
         cfg = self.config
         b, s = input_ids.shape
@@ -399,6 +451,10 @@ class DecoderLM(nn.Module):
             raise ValueError(
                 "cache_positions (slot-arena decode) requires use_cache=True "
                 "and decode=True"
+            )
+        if page_table is not None and cache_positions is None:
+            raise ValueError(
+                "page_table (paged slot-arena decode) requires cache_positions"
             )
         if use_cache and self._effective_stages() > 1:
             raise NotImplementedError(
@@ -484,16 +540,17 @@ class DecoderLM(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layer"},
             )
-            (x, moe_aux, _, _, _), _ = ScanStack(
+            (x, moe_aux, _, _, _, _), _ = ScanStack(
                 cfg, self.mesh, use_cache, decode, deterministic, name="layers"
-            )((x, jnp.float32(0.0), sin, cos, cache_positions), None)
+            )((x, jnp.float32(0.0), sin, cos, cache_positions, page_table), None)
         else:
             block_cls = _maybe_streaming(DecoderBlock, cfg)
             if cfg.remat:
                 block_cls = nn.remat(block_cls, prevent_cse=True, policy=_remat_policy(cfg))
             for i in range(cfg.num_layers):
                 x, block_aux = block_cls(cfg, self.mesh, use_cache, decode, name=f"layer_{i}")(
-                    x, sin, cos, deterministic, cache_positions=cache_positions
+                    x, sin, cos, deterministic, cache_positions=cache_positions,
+                    page_table=page_table,
                 )
                 moe_aux = moe_aux + block_aux
 

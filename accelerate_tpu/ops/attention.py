@@ -658,6 +658,49 @@ def decode_attention(
     return mha_reference(q, k, v, causal=False, sm_scale=sm_scale, bias=bias)
 
 
+def gather_kv_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:
+    """Materialize per-slot dense K (or V) from a paged arena.
+
+    ``pages``: [num_pages, KVH, page_size, D] physical pages; ``page_table``:
+    [B, P] int32 page ids per slot (row p of the result's length axis is
+    global position p: the table is position-ordered, so ``page_table[b, c]``
+    holds positions ``[c*page_size, (c+1)*page_size)``). Returns
+    [B, KVH, P*page_size, D]. Duplicate table entries (the parking page
+    padding unallocated tail entries) are fine — their rows sit beyond the
+    slot's frontier and the decode mask zeroes them.
+    """
+    g = pages[page_table]                      # [B, P, KVH, page_size, D]
+    g = jnp.swapaxes(g, 1, 2)                  # [B, KVH, P, page_size, D]
+    b, kvh, p, ps, d = g.shape
+    return g.reshape(b, kvh, p * ps, d)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    *,
+    page_table: jax.Array,
+    q_positions: jax.Array,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Decode attention reading K/V through a per-slot page table.
+
+    q: [B, H, Sq, D]; k_pages/v_pages: [num_pages, KVH, page_size, D];
+    ``page_table`` [B, P] int32; ``q_positions`` [B, Sq] global positions.
+    The gather maps each slot's pages back into position order, after which
+    the read is exactly :func:`decode_attention`'s masked-dense path — the
+    CPU-sim fallback and the bit-exactness reference for any future pallas
+    paged kernel (ROADMAP item 2: a length-aware kernel walking only live
+    pages would cut the HBM read from arena capacity to live tokens; the
+    gather form keeps ONE semantic code path until that lands, which is what
+    makes paged decode provably token-exact vs. the dense arena).
+    """
+    k_full = gather_kv_pages(k_pages, page_table)
+    v_full = gather_kv_pages(v_pages, page_table)
+    return decode_attention(q, k_full, v_full, q_positions=q_positions, sm_scale=sm_scale)
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,

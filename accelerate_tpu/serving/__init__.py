@@ -1,5 +1,32 @@
-"""Continuous-batching serving: slot-arena KV cache, chunked prefill
-admission, donated in-place batched decode (docs/serving.md)."""
+"""Continuous-batching serving: slot-arena KV cache (flat or paged with a
+copy-on-write prefix cache), chunked prefill admission, donated in-place
+batched decode, and speculative decoding (docs/serving.md).
 
-from .arena import arena_nbytes, arena_num_slots, init_arena  # noqa: F401
-from .engine import Request, ServingEngine, generate_batched  # noqa: F401
+PEP 562 lazy re-exports: ``serving.pages`` is host-side bookkeeping
+(free lists, refcounts, prefix hashing, the n-gram drafter) that a
+router/scheduler tier imports on machines with no accelerator stack, so
+importing it must not drag the jax-heavy engine in (tests/test_imports).
+"""
+
+_EXPORTS = {
+    "arena_nbytes": "arena",
+    "arena_num_slots": "arena",
+    "init_arena": "arena",
+    "Request": "engine",
+    "ServingEngine": "engine",
+    "generate_batched": "engine",
+    "NGramDrafter": "pages",
+    "PageAllocator": "pages",
+    "PrefixCache": "pages",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -31,6 +31,7 @@ so ``generate_batched()`` output is token-for-token equal to sequential
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -42,6 +43,18 @@ import numpy as np
 
 from ..generation import _sample, _sized_definition, depipeline
 from .arena import arena_nbytes, init_arena, slot_view, write_slot
+from .pages import (
+    NGramDrafter,
+    PageAllocator,
+    PagedTables,
+    PrefixCache,
+    dense_slot_view,
+    fork_page,
+    init_paged_arena,
+    scatter_slot_view,
+    set_table_entry,
+    set_table_row,
+)
 
 
 @dataclass
@@ -64,6 +77,12 @@ class Request:
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     _last_token_t: float = 0.0
+    # paged-arena attribution (request records carry these so
+    # `accelerate-tpu trace`/`report` can attribute per-request TTFT wins)
+    prefix_hit: int = 0        # prompt tokens served from the prefix cache
+    pages_allocated: int = 0   # fresh pages this request consumed (forks incl.)
+    spec_proposed: int = 0     # draft tokens proposed for this request
+    spec_accepted: int = 0     # draft tokens accepted by verify steps
 
     def result(self) -> np.ndarray:
         """[prompt + generated] token ids (the ``generate()`` contract)."""
@@ -79,10 +98,28 @@ class ServingEngine:
     the prompt, ``max_new_tokens``, the RNG seed, and the streaming
     callback.
 
+    ``page_size`` switches the KV storage to the **paged arena**
+    (``pages.py``): fixed-size pages + per-slot page tables instead of a
+    dense ``num_slots x max_cache_len`` block, with ``num_pages`` physical
+    pages (default: capacity-equivalent to the flat arena plus the parking
+    page; set it lower to overcommit — more slots per HBM byte when real
+    lengths are below ``max_cache_len``). With ``prefix_cache`` on,
+    admissions whose prompt prefix is cached map the shared pages
+    (copy-on-write) and prefill only the tail. ``spec_draft_len=K`` adds
+    speculative decoding: the host-side ``drafter`` (default
+    :class:`~.pages.NGramDrafter`) proposes K tokens and ONE batched
+    verify step checks all of them, emitting the longest accepted prefix
+    plus one fresh token — token-exact vs. sequential decode under both
+    greedy and sampled decoding (rollback is free: rejected drafts land
+    beyond the frontier, where the decode mask already hides them). Spec
+    reserves ``spec_draft_len`` tokens of per-slot KV headroom.
+
     The decode step and every prefill-chunk bucket compile exactly once;
     after ``mark_steady()`` the ``admission_recompiles`` property must
-    stay 0 no matter what prompt lengths arrive — the recompile invariant
-    the bench (`serving_admission_recompiles`) and tests assert.
+    stay 0 no matter what traffic arrives — admissions, prefix hits, page
+    forks and speculative verify steps are all pure data changes — the
+    recompile invariant the bench (`serving_admission_recompiles`) and
+    tests assert.
     """
 
     def __init__(
@@ -100,6 +137,11 @@ class ServingEngine:
         param_placer=None,
         donate: Optional[bool] = None,
         telemetry=None,
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        prefix_cache: bool = True,
+        spec_draft_len: int = 0,
+        drafter=None,
     ):
         from ..utils.compile_cache import (
             compile_event_counters,
@@ -145,7 +187,70 @@ class ServingEngine:
             donate if donate is not None else jax.default_backend() != "cpu"
         )
 
-        self._arena = init_arena(definition, params, self.num_slots, self._placer)
+        # -- paged arena / prefix cache / speculative decoding -------------
+        self.page_size = int(page_size) if page_size else None
+        self.spec_k = max(0, int(spec_draft_len))
+        if self.spec_k and not self.page_size:
+            raise ValueError(
+                "speculative decoding (spec_draft_len > 0) requires the "
+                "paged arena; pass page_size=..."
+            )
+        if self.page_size:
+            if self.max_cache_len % self.page_size:
+                raise ValueError(
+                    f"page_size ({self.page_size}) must divide max_cache_len "
+                    f"({self.max_cache_len})"
+                )
+            self.pages_per_slot = self.max_cache_len // self.page_size
+            # default: capacity-equivalent to the flat arena (+ the parking
+            # page). Overcommit by passing a smaller num_pages.
+            self.num_pages = (
+                int(num_pages) if num_pages
+                else 1 + self.num_slots * self.pages_per_slot
+            )
+            if self.num_pages < 2:
+                raise ValueError(f"num_pages ({self.num_pages}) must be >= 2")
+            self._paged_def = definition.clone(config=dataclasses.replace(
+                definition.config,
+                kv_page_size=self.page_size, kv_num_pages=self.num_pages,
+            ))
+            self._allocator = PageAllocator(self.num_pages, reserved=1)
+            self._tables_host = PagedTables(
+                self.num_slots, self.pages_per_slot, parking=0
+            )
+            self._prefix = (
+                PrefixCache(self._allocator, self.page_size) if prefix_cache
+                else None
+            )
+            self._drafter = drafter or (NGramDrafter() if self.spec_k else None)
+            self._arena = init_paged_arena(
+                self._paged_def, params, self.num_slots, self.pages_per_slot,
+                self._placer,
+            )
+            self._page_tables = jnp.zeros(
+                (self.num_slots, self.pages_per_slot), jnp.int32
+            )
+            table_donate = (0,) if self._donate else ()
+            self._set_row = jax.jit(set_table_row, donate_argnums=table_donate)
+            self._set_entry = jax.jit(set_table_entry, donate_argnums=table_donate)
+            self._fork = jax.jit(
+                fork_page, donate_argnums=(0,) if self._donate else ()
+            )
+            self._verify_step = (
+                jax.jit(self._build_verify_core(),
+                        donate_argnums=(1, 2, 4, 6) if self._donate else ())
+                if self.spec_k else None
+            )
+        else:
+            self._paged_def = None
+            self._prefix = None
+            self._drafter = None
+            self._verify_step = None
+            self._arena = init_arena(definition, params, self.num_slots, self._placer)
+        self.page_forks = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.prefill_chunks_skipped = 0
         self.arena_bytes = arena_nbytes(self._arena)
         self._tokens = jnp.zeros((self.num_slots,), jnp.int32)
         self._lengths = jnp.zeros((self.num_slots,), jnp.int32)
@@ -190,12 +295,14 @@ class ServingEngine:
     # -- compiled programs -------------------------------------------------
 
     def _build_step_core(self):
-        definition, placer = self.definition, self._placer
+        placer = self._placer
         temperature, top_k = self.temperature, self.top_k
+        paged = self.page_size is not None
+        definition = self._paged_def if paged else self.definition
 
         last_pos = self.max_cache_len - 1
 
-        def step(params, arena, tokens, lengths, active, rngs):
+        def step(params, arena, tokens, lengths, active, rngs, page_tables=None):
             """One batched decode step -> (arena, tokens, lengths, rngs).
             Jitted directly as the single step and scanned by the bursts."""
             # inactive slots still flow through the fused step (fixed batch)
@@ -204,8 +311,11 @@ class ServingEngine:
             # interleaved, and a stray write there corrupts its prefix.
             # Park them on the LAST cache position instead — any request
             # that legitimately reaches it writes its own K/V there before
-            # attending, so the garbage is unreachable.
+            # attending, so the garbage is unreachable. (Paged: a freed
+            # slot's table row is reset to the parking page, so a parked
+            # write can never land in another request's page.)
             write_pos = jnp.where(active, lengths, last_pos)
+            kwargs = {"page_table": page_tables} if paged else {}
             out, mutated = definition.apply(
                 {"params": placer(params), "cache": arena},
                 tokens[:, None],
@@ -214,6 +324,7 @@ class ServingEngine:
                 decode=True,
                 cache_positions=write_pos,
                 mutable=["cache"],
+                **kwargs,
             )
             logits = out["logits"][:, -1]  # [N, V]
             split = jax.vmap(jax.random.split)(rngs)  # [N, 2, 2]
@@ -233,6 +344,68 @@ class ServingEngine:
 
         return step
 
+    def _build_verify_core(self):
+        """The speculative verify step: feed ``[last_token, d1..dK]`` per
+        slot at positions ``lengths..lengths+K``, sample a candidate at
+        every position with the EXACT per-step RNG subkeys the sequential
+        chain would draw, and accept the longest draft prefix that matches.
+        Emitted tokens are always the target model's own samples — drafts
+        only decide how many verify in one dispatch — so output is
+        token-exact vs. K+1 sequential steps for greedy AND sampled
+        decoding. Rollback costs nothing: rejected drafts' K/V sit beyond
+        the new frontier, where the decode mask already hides them and the
+        next write overwrites them (the same argument that makes slot reuse
+        clearing-free)."""
+        placer = self._placer
+        temperature, top_k = self.temperature, self.top_k
+        definition = self._paged_def
+        last_pos = self.max_cache_len - 1
+
+        def verify(params, arena, tokens, drafts, lengths, active, rngs, page_tables):
+            n, k = drafts.shape
+            seq = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [N, K+1]
+            pos = lengths[:, None] + jnp.arange(k + 1)[None, :]
+            write_pos = jnp.where(active[:, None], pos, last_pos)
+            out, mutated = definition.apply(
+                {"params": placer(params), "cache": arena},
+                seq,
+                positions=write_pos,
+                use_cache=True,
+                decode=True,
+                cache_positions=write_pos,
+                page_table=page_tables,
+                mutable=["cache"],
+            )
+            logits = out["logits"]  # [N, K+1, V]
+
+            def chain(rng):
+                # replay the sequential loop's split discipline: at each
+                # step split -> (carry, sub); collect each step's sub AND
+                # the carry after it, so any accepted count lands on the
+                # exact chain state sequential decode would hold
+                def body(r, _):
+                    nxt = jax.random.split(r)
+                    return nxt[0], (nxt[1], nxt[0])
+
+                _, (subs, states) = jax.lax.scan(body, rng, None, length=k + 1)
+                return subs, states  # each [K+1, 2]
+
+            subs, states = jax.vmap(chain)(rngs)
+            cand = jax.vmap(
+                jax.vmap(lambda key, row: _sample(row[None], key, temperature, top_k)[0])
+            )(subs, logits)  # [N, K+1]
+            matched = (cand[:, :k] == drafts).astype(jnp.int32)
+            m = jnp.sum(jnp.cumprod(matched, axis=1), axis=1)  # accepted drafts
+            rows = jnp.arange(n)
+            new_last = cand[rows, m]           # first non-matching / bonus token
+            new_rngs = states[rows, m]         # chain after m+1 splits
+            new_tokens = jnp.where(active, new_last, tokens)
+            new_lengths = jnp.where(active, lengths + m + 1, lengths)
+            new_rngs = jnp.where(active[:, None], new_rngs, rngs)
+            return mutated["cache"], new_tokens, new_lengths, new_rngs, cand, m
+
+        return verify
+
     def _decode_burst(self, k: int):
         """K fused decode steps in one dispatch: a lax.scan over the single
         step body, so tokens are bit-identical to K separate steps. Returns
@@ -242,11 +415,11 @@ class ServingEngine:
             return fn
         core = self._step_core
 
-        def burst(params, arena, tokens, lengths, active, rngs):
+        def burst(params, arena, tokens, lengths, active, rngs, page_tables=None):
             def body(carry, _):
                 arena, tokens, lengths, rngs = carry
                 arena, tokens, lengths, rngs = core(
-                    params, arena, tokens, lengths, active, rngs
+                    params, arena, tokens, lengths, active, rngs, page_tables
                 )
                 return (arena, tokens, lengths, rngs), tokens
 
@@ -265,24 +438,40 @@ class ServingEngine:
             return fn
         definition, placer = self.definition, self._placer
         temperature, top_k = self.temperature, self.top_k
+        paged = self.page_size is not None
 
-        def prefill(params, arena, chunk_ids, slot, start, last_idx, rng):
+        def prefill(params, arena, chunk_ids, slot, start, last_idx, rng,
+                    page_tables=None):
             # per-slot chunked prefill rides the scalar-cache_index decode
             # path: queries at global positions start..start+C-1 attend the
-            # slot's full prefix — exact continuation across chunks
+            # slot's full prefix — exact continuation across chunks. On the
+            # paged arena the slot view is GATHERED from its pages into
+            # dense position order first and scattered back after, so the
+            # model-side chunk program (and its exactness contract) is the
+            # same one the flat arena runs.
+            if paged:
+                row = jax.lax.dynamic_index_in_dim(
+                    page_tables, slot, 0, keepdims=False
+                )
+                view = dense_slot_view(arena, row, start)
+            else:
+                view = slot_view(arena, slot, start)
             out, mutated = definition.apply(
-                {"params": placer(params), "cache": slot_view(arena, slot, start)},
+                {"params": placer(params), "cache": view},
                 chunk_ids,  # [1, C]
                 positions=start + jnp.arange(bucket),
                 use_cache=True,
                 decode=True,
                 mutable=["cache"],
             )
-            arena = write_slot(arena, mutated["cache"], slot)
+            if paged:
+                arena = scatter_slot_view(arena, mutated["cache"], row)
+            else:
+                arena = write_slot(arena, mutated["cache"], slot)
             # first-token sample from the last VALID row (padding rows of a
             # bucketed final chunk produce garbage logits we never read)
-            row = jax.lax.dynamic_index_in_dim(out["logits"][0], last_idx, 0, keepdims=False)
-            first = _sample(row[None], rng, temperature, top_k)[0]
+            row_l = jax.lax.dynamic_index_in_dim(out["logits"][0], last_idx, 0, keepdims=False)
+            first = _sample(row_l[None], rng, temperature, top_k)[0]
             return arena, first
 
         fn = jax.jit(prefill, donate_argnums=(1,) if self._donate else ())
@@ -301,7 +490,10 @@ class ServingEngine:
         if self._slot_req or self._queue or self._admitting is not None:
             raise RuntimeError("warmup() needs an idle engine")
         rng = jax.random.PRNGKey(0)
-        jax.random.split(rng)  # the eager per-admission ops
+        # the eager per-admission ops, UNPACKED like _advance_admission does:
+        # iterating the split result compiles the index programs too, and
+        # they must not count against the post-steady recompile invariant
+        _, _ = jax.random.split(rng)
         if self.telemetry is not None:
             from ..telemetry import forensics
 
@@ -315,22 +507,35 @@ class ServingEngine:
                          "temperature": self.temperature, "top_k": self.top_k},
             )
         costs = getattr(self.telemetry, "costs", None)
+        paged = self.page_size is not None
+        pk = {"page_tables": self._page_tables} if paged else {}
         for bucket in self.prefill_chunks:
             warm_chunk = jnp.zeros((1, bucket), jnp.int32)
             self._note_forensics(f"prefill_{bucket}", {"chunk_ids": warm_chunk})
             self._arena, _ = self._prefill_fn(bucket)(
                 self.params, self._arena, warm_chunk,
-                0, 0, bucket - 1, rng,
+                0, 0, bucket - 1, rng, **pk,
             )
             if costs is not None:
                 # roofline row per bucket; one re-trace, and the compiled
                 # memory analysis only when the persistent cache serves it
                 try:
                     costs.capture_lowered(f"prefill_{bucket}", self._prefill_fn(bucket).lower(
-                        self.params, self._arena, warm_chunk, 0, 0, bucket - 1, rng,
+                        self.params, self._arena, warm_chunk, 0, 0, bucket - 1, rng, **pk,
                     ))
                 except Exception:
                     pass
+        if paged:
+            # the page-table maintenance programs: row install (admission),
+            # entry scatter (growth), page fork (copy-on-write). All traced-
+            # index data ops — one compile each, any slot/page thereafter.
+            # Warmup runs them as no-ops against the idle state (row 0 is
+            # already parking; forking the parking page onto itself).
+            self._page_tables = self._set_row(
+                self._page_tables, 0, jnp.asarray(self._tables_host.rows[0])
+            )
+            self._page_tables = self._set_entry(self._page_tables, 0, 0, 0)
+            self._arena = self._fork(self._arena, 0, 0)
         self._tokens, self._lengths, self._rngs = self._admit_state(
             self._tokens, self._lengths, self._rngs, 0, 0, 0, rng
         )
@@ -339,17 +544,47 @@ class ServingEngine:
             {"tokens": self._tokens, "lengths": self._lengths,
              "active": self._active, "rngs": self._rngs},
         )
+        step_extra = (self._page_tables,) if paged else ()
         self._arena, self._tokens, self._lengths, self._rngs = self._decode_step(
             self.params, self._arena, self._tokens, self._lengths, self._active,
-            self._rngs,
+            self._rngs, *step_extra,
         )
         if self.steps_per_call > 1:
             self._arena, self._tokens, self._lengths, self._rngs, _ = (
                 self._decode_burst(self.steps_per_call)(
                     self.params, self._arena, self._tokens, self._lengths,
-                    self._active, self._rngs,
+                    self._active, self._rngs, *step_extra,
                 )
             )
+        if self._verify_step is not None:
+            # the speculative verify program: all-inactive, so state freezes
+            warm_drafts = jnp.zeros((self.num_slots, self.spec_k), jnp.int32)
+            # fingerprint the FULL steady-state arg set (what
+            # _spec_verify_once notes), so a later diagnosed recompile
+            # diffs against it instead of reporting every arg as new
+            self._note_forensics(
+                "spec_verify",
+                {"tokens": self._tokens, "drafts": warm_drafts,
+                 "lengths": self._lengths, "active": self._active,
+                 "rngs": self._rngs},
+            )
+            self._arena, self._tokens, self._lengths, self._rngs, _, _ = (
+                self._verify_step(
+                    self.params, self._arena, self._tokens, warm_drafts,
+                    self._lengths, self._active, self._rngs, self._page_tables,
+                )
+            )
+            if costs is not None:
+                # CostRegistry row for the verify executable, so the
+                # speculative win is attributable in the roofline table
+                try:
+                    costs.capture_lowered("spec_verify", self._verify_step.lower(
+                        self.params, self._arena, self._tokens, warm_drafts,
+                        self._lengths, self._active, self._rngs,
+                        self._page_tables,
+                    ))
+                except Exception:
+                    pass
         jax.device_get(self._tokens)
         # snapshot the decode step's memory_analysis here on the engine
         # thread so a later flight dump never has to; the AOT re-lower hits
@@ -379,11 +614,15 @@ class ServingEngine:
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         cover = self._plan_cover(prompt.size)
-        if prompt.size + max_new_tokens > self.max_cache_len or cover > self.max_cache_len:
+        # speculative verify writes up to spec_k positions past the last
+        # sequential write, so spec reserves that much per-slot headroom
+        need = prompt.size + max_new_tokens + self.spec_k
+        if need > self.max_cache_len or cover > self.max_cache_len:
             raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds the slot KV capacity ({self.max_cache_len}); raise "
-                "max_cache_len"
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens})"
+                + (f" + spec headroom ({self.spec_k})" if self.spec_k else "")
+                + f" exceeds the slot KV capacity ({self.max_cache_len}); "
+                "raise max_cache_len"
             )
         req = Request(
             prompt=prompt,
@@ -500,6 +739,129 @@ class ServingEngine:
         start, bucket = plan[-1]
         return start + bucket
 
+    # -- paged-arena bookkeeping -------------------------------------------
+
+    def _alloc_page(self) -> int:
+        """One fresh page, evicting LRU prefix-cache entries under
+        pressure. Exhaustion with nothing left to evict is an overcommit
+        misconfiguration, not a recoverable state — raise loudly."""
+        page = self._allocator.alloc()
+        while page is None and self._prefix is not None and self._prefix.evict_lru():
+            page = self._allocator.alloc()
+        if page is None:
+            raise RuntimeError(
+                f"paged KV arena exhausted ({self.num_pages} pages, "
+                f"{len(self._slot_req)} live slots): raise num_pages or "
+                "lower num_slots/max_new_tokens for this overcommit ratio"
+            )
+        return page
+
+    def _ensure_writable(self, req, slot: int, lo_pos: int, hi_pos: int):
+        """Before a dispatch that writes positions [lo_pos, hi_pos] for
+        ``slot``: grow its page table to cover hi_pos, and copy-on-write
+        fork any page in the write range that is shared (prefix cache or
+        another slot still references it). Pure data changes: a table-entry
+        scatter per new page and one fork program per copy."""
+        th = self._tables_host
+        ps = self.page_size
+        p_hi = hi_pos // ps
+        while th.alloc_count[slot] <= p_hi:
+            idx = th.alloc_count[slot]
+            page = self._alloc_page()
+            th.rows[slot][idx] = page
+            th.alloc_count[slot] = idx + 1
+            self._page_tables = self._set_entry(self._page_tables, slot, idx, page)
+            req.pages_allocated += 1
+        for idx in range(lo_pos // ps, p_hi + 1):
+            page = int(th.rows[slot][idx])
+            if not self._allocator.shared(page):
+                continue
+            fresh = self._alloc_page()
+            self._arena = self._fork(self._arena, page, fresh)
+            self._allocator.release(page)
+            th.rows[slot][idx] = fresh
+            self._page_tables = self._set_entry(self._page_tables, slot, idx, fresh)
+            req.pages_allocated += 1
+            self.page_forks += 1
+
+    def _paged_admit_plan(self, req: Request, slot: int) -> list:
+        """Map the longest cached prompt prefix into the slot's fresh page
+        table (refcount++ per shared page) and return the chunk plan for
+        the UNCACHED tail only — the prefix-cache TTFT win. At least the
+        prompt's final token always prefills: its logits seed the first
+        sampled token. Returns [(global_start, bucket), ...]."""
+        th = self._tables_host
+        th.reset_slot(slot)
+        cold_chunks = len(self._plan_chunks(req.prompt.size))
+        hit_len = 0
+        entry = None
+        if self._prefix is not None:
+            hit_len, entry = self._prefix.lookup(
+                req.prompt, limit=req.prompt.size - 1
+            )
+            # the tail plan must still fit the slot (its padded cover can
+            # exceed the whole-prompt cover when the tail is tiny)
+            while hit_len and (
+                hit_len + self._plan_cover(req.prompt.size - hit_len)
+                > self.max_cache_len
+            ):
+                hit_len = max(0, hit_len - self.page_size)
+            # a hit whose tail needs MORE prefill dispatches than the cold
+            # plan (e.g. cached 64 of a 256 prompt that cold-plans as one
+            # 256 chunk but tail-plans as three 64s) is a TTFT loss, not a
+            # win — decline it
+            if hit_len and (
+                len(self._plan_chunks(req.prompt.size - hit_len)) > cold_chunks
+            ):
+                hit_len = 0
+            if hit_len == 0:
+                entry = None
+            self._prefix.record_hit(hit_len, entry)
+        if entry is not None:
+            n_map = -(-hit_len // self.page_size)
+            for i in range(n_map):
+                page = int(entry.pages[i])
+                self._allocator.retain(page)
+                th.rows[slot][i] = page
+            th.alloc_count[slot] = n_map
+        req.prefix_hit = hit_len
+        if hit_len:
+            # prefill chunks the cached prefix made unnecessary (TTFT
+            # attribution; the cold plan is what a miss would have run)
+            self.prefill_chunks_skipped += cold_chunks - len(
+                self._plan_chunks(req.prompt.size - hit_len)
+            )
+        self._page_tables = self._set_row(
+            self._page_tables, slot, jnp.asarray(th.rows[slot])
+        )
+        tail_plan = self._plan_chunks(req.prompt.size - hit_len)
+        return [(hit_len + start, bucket) for start, bucket in tail_plan]
+
+    def _insert_prefix(self, req: Request, slot: int):
+        """Admission finished: publish this prompt's pages to the prefix
+        cache (every page-aligned prefix + the full prompt). The request's
+        own boundary page becomes shared here — its first decode write
+        into that page forks it, leaving the cached copy pristine."""
+        if self._prefix is None:
+            return
+        n_pages = -(-req.prompt.size // self.page_size)
+        if n_pages > self._tables_host.alloc_count[slot]:
+            return  # cannot happen post-prefill; guard for safety
+        self._prefix.insert(req.prompt, self._tables_host.rows[slot])
+
+    def _release_slot_pages(self, slot: int):
+        """Eviction: drop the slot's page references (pages still retained
+        by the prefix cache or another slot survive) and point its device
+        table row back at the parking page, so a later all-inactive fused
+        step can never write into a page that was reallocated."""
+        th = self._tables_host
+        for page in th.slot_pages(slot):
+            self._allocator.release(page)
+        th.reset_slot(slot)
+        self._page_tables = self._set_row(
+            self._page_tables, slot, jnp.asarray(th.rows[slot])
+        )
+
     def _advance_admission(self) -> bool:
         tr = self._tracer()
         if self._admitting is None:
@@ -508,7 +870,10 @@ class ServingEngine:
             req = self._queue.popleft()
             slot = self._free.pop()
             prefill_rng, decode_rng = jax.random.split(req.rng)
-            plan = self._plan_chunks(req.prompt.size)
+            if self.page_size:
+                plan = self._paged_admit_plan(req, slot)
+            else:
+                plan = self._plan_chunks(req.prompt.size)
             self._admitting = [req, slot, plan, 0, prefill_rng, decode_rng]
             if tr is not None:
                 tr.on_admission(req, slot, time.perf_counter() - req.submit_t)
@@ -521,10 +886,17 @@ class ServingEngine:
         chunk_dev = jnp.asarray(chunk)
         self._note_forensics(f"prefill_{bucket}", {"chunk_ids": chunk_dev})
         t0 = time.perf_counter()
-        self._arena, first = self._prefill_fn(bucket)(
-            self.params, self._arena, chunk_dev, slot, start, last_idx,
-            prefill_rng,
-        )
+        if self.page_size:
+            self._ensure_writable(req, slot, start, start + bucket - 1)
+            self._arena, first = self._prefill_fn(bucket)(
+                self.params, self._arena, chunk_dev, slot, start, last_idx,
+                prefill_rng, page_tables=self._page_tables,
+            )
+        else:
+            self._arena, first = self._prefill_fn(bucket)(
+                self.params, self._arena, chunk_dev, slot, start, last_idx,
+                prefill_rng,
+            )
         wall = time.perf_counter() - t0
         if tr is not None:
             tr.on_prefill_chunk(req, slot, start, bucket, t0, wall)
@@ -536,6 +908,8 @@ class ServingEngine:
             return True
         # final chunk done -> the slot goes live with its first token
         self._admitting = None
+        if self.page_size:
+            self._insert_prefix(req, slot)
         first_tok = int(jax.device_get(first))
         length = int(req.prompt.size)
         self._tokens, self._lengths, self._rngs = self._admit_state(
@@ -566,28 +940,105 @@ class ServingEngine:
         )
         return k if remaining >= k else 1
 
+    def _next_write_pos(self, req: Request) -> int:
+        """The slot's next cache write position: the latest emitted token's
+        K/V has not been written yet (prefill samples the first token, each
+        decode step writes the PREVIOUS token before sampling the next)."""
+        return req.prompt.size + len(req.tokens) - 1
+
+    def _spec_verify_once(self) -> bool:
+        """One speculative round: host drafter proposes K tokens per slot,
+        one batched verify dispatch checks them all, the longest accepted
+        prefix (plus the bonus sample) is emitted. Replaces the burst when
+        spec is on — both amortize the host round trip, but verify turns
+        the decode step's idle MXU into accepted tokens."""
+        k = self.spec_k
+        drafts = np.zeros((self.num_slots, k), np.int32)
+        # a drafter exposing `lookback` only reads that many trailing
+        # tokens, so build just the context tail — rebuilding the full
+        # prompt+generation history every round is O(T^2) over a generation
+        lb = int(getattr(self._drafter, "lookback", 0) or 0)
+        for slot, req in self._slot_req.items():
+            gen = np.asarray(req.tokens[-lb:] if lb else req.tokens, np.int32)
+            if lb and gen.size >= lb:
+                ctx = gen
+            else:
+                head = req.prompt[-(lb - gen.size):] if lb else req.prompt
+                ctx = np.concatenate([np.asarray(head, np.int32), gen])
+            drafts[slot] = self._drafter.propose(ctx, k)
+            pos = self._next_write_pos(req)
+            self._ensure_writable(req, slot, pos, pos + k)
+        drafts_dev = jnp.asarray(drafts)
+        self._note_forensics(
+            "spec_verify",
+            {"tokens": self._tokens, "drafts": drafts_dev,
+             "lengths": self._lengths, "active": self._active,
+             "rngs": self._rngs},
+        )
+        t0 = time.perf_counter()
+        (self._arena, self._tokens, self._lengths, self._rngs, cand, m) = (
+            self._verify_step(
+                self.params, self._arena, self._tokens, drafts_dev,
+                self._lengths, self._active, self._rngs, self._page_tables,
+            )
+        )
+        cand_h = np.asarray(jax.device_get(cand))  # [N, K+1]; forces the step
+        m_h = np.asarray(jax.device_get(m))
+        now = time.perf_counter()
+        wall = now - t0
+        self.step_count += 1
+        emitted = 0
+        for slot, req in list(self._slot_req.items()):
+            accepted = int(m_h[slot])
+            n_emit = accepted + 1
+            req.spec_proposed += k
+            req.spec_accepted += accepted
+            self.spec_proposed += k
+            self.spec_accepted += accepted
+            for i in range(n_emit):
+                # amortize the verify wall across this slot's emitted run
+                # (same reasoning as the fused-burst ITL amortization)
+                self._emit(req, int(cand_h[slot, i]), t0 + wall * (i + 1) / n_emit)
+                emitted += 1
+                if req.done:
+                    break  # budget/eos hit mid-run: drop the rest
+        self._step_samples.append((wall, emitted, 1))
+        if self.telemetry is not None:
+            self.telemetry.on_step(self, wall, tokens=emitted, steps=1)
+            costs = getattr(self.telemetry, "costs", None)
+            if costs is not None:
+                costs.note_wall("spec_verify", wall)
+        return True
+
     def _decode_once(self) -> bool:
         if not self._slot_req:
             return False
+        if self.spec_k:
+            return self._spec_verify_once()
         k = self._burst_len()
+        if self.page_size:
+            for slot, req in self._slot_req.items():
+                pos = self._next_write_pos(req)
+                self._ensure_writable(req, slot, pos, pos + k - 1)
         self._note_forensics(
             "decode_step" if k == 1 else f"decode_burst{k}",
             {"tokens": self._tokens, "lengths": self._lengths,
              "active": self._active, "rngs": self._rngs},
         )
+        step_extra = (self._page_tables,) if self.page_size else ()
         t0 = time.perf_counter()
         if k > 1:
             self._arena, self._tokens, self._lengths, self._rngs, toks = (
                 self._decode_burst(k)(
                     self.params, self._arena, self._tokens, self._lengths,
-                    self._active, self._rngs,
+                    self._active, self._rngs, *step_extra,
                 )
             )
             host = np.asarray(jax.device_get(toks))  # [K, N]; forces the burst
         else:
             self._arena, self._tokens, self._lengths, self._rngs = self._decode_step(
                 self.params, self._arena, self._tokens, self._lengths, self._active,
-                self._rngs,
+                self._rngs, *step_extra,
             )
             host = np.asarray(jax.device_get(self._tokens))[None]  # [1, N]
         now = time.perf_counter()
@@ -642,6 +1093,8 @@ class ServingEngine:
         if req.slot is not None:
             self._slot_req.pop(req.slot, None)
             self._active[req.slot] = False
+            if self.page_size:
+                self._release_slot_pages(req.slot)
             self._free.append(req.slot)
             req.slot = None
         self.requests_completed += 1
@@ -677,9 +1130,10 @@ class ServingEngine:
         if self._exe_mem is not None or cached_only:
             return self._exe_mem or {}
         try:
+            step_extra = (self._page_tables,) if self.page_size else ()
             compiled = self._decode_step.lower(
                 self.params, self._arena, self._tokens, self._lengths,
-                self._active, self._rngs,
+                self._active, self._rngs, *step_extra,
             ).compile()
             costs = getattr(self.telemetry, "costs", None)
             if costs is not None:
@@ -721,6 +1175,23 @@ class ServingEngine:
             itl = np.asarray(self._itl)
             out["serving/itl_p50_ms"] = 1e3 * float(np.percentile(itl, 50))
             out["serving/itl_p95_ms"] = 1e3 * float(np.percentile(itl, 95))
+        if self.page_size:
+            out["serving/pages_in_use"] = self._allocator.in_use
+            out["serving/pages_total"] = self.num_pages
+            out["serving/page_size"] = self.page_size
+            out["serving/page_forks"] = self.page_forks
+            if self._prefix is not None:
+                out["serving/prefix_hit_ratio"] = self._prefix.hit_ratio
+                out["serving/prefix_hit_tokens"] = self._prefix.hit_tokens
+                out["serving/prefix_entries"] = len(self._prefix.entries)
+                out["serving/prefill_chunks_skipped"] = self.prefill_chunks_skipped
+        if self.spec_k:
+            out["serving/spec_proposed"] = self.spec_proposed
+            out["serving/spec_accepted"] = self.spec_accepted
+            out["serving/spec_accept_rate"] = (
+                self.spec_accepted / self.spec_proposed if self.spec_proposed
+                else 0.0
+            )
         if self._steady_mark is not None:
             out["serving/admission_recompiles"] = self.admission_recompiles
         return out

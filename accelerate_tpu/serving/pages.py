@@ -1,0 +1,405 @@
+"""Paged KV arena: fixed-size pages, refcounted free list, copy-on-write
+prefix cache, and the n-gram drafter for speculative decoding.
+
+The flat slot arena (``arena.py``) reserves ``max_cache_len`` of KV per
+slot no matter how long the request actually is, and every request pays a
+full prefill even when thousands share a templated system prompt. This
+module replaces the storage layer with **pages**:
+
+- K/V leaves become ``[num_pages, KVH, page_size, D]`` physical pages (a
+  leading layer axis under ``scan_layers``); a per-slot **page table**
+  ``[num_slots, pages_per_slot] int32`` maps each slot's position range
+  ``[c*page_size, (c+1)*page_size)`` to a physical page. Page 0 is the
+  reserved **parking page**: unallocated table entries point at it, and
+  inactive slots' fused-step writes land there.
+- the **free list + refcounts** live host-side (:class:`PageAllocator`);
+  admission/growth/eviction are pure data changes (table-entry scatters),
+  so the zero-recompile discipline of the flat arena carries over.
+- the **prefix cache** (:class:`PrefixCache`) keys page-aligned prompt
+  prefixes by token hash. A request whose prompt prefix is cached maps the
+  shared pages into its table (refcount++) and prefills only the tail —
+  near-zero TTFT for templated traffic. Shared pages are **copy-on-write**:
+  the engine forks (copies) a page before the first divergent write, so a
+  mutation by one slot can never perturb another slot's tokens.
+- the **n-gram drafter** (:class:`NGramDrafter`) is the host-side,
+  model-free proposer for speculative decoding: it looks the request's most
+  recent n-gram up in its own prompt+generation history and proposes the
+  continuation — free draft tokens for templated/repetitive traffic that
+  the batched verify step then accepts or rolls back token-exactly.
+
+Everything above the device helpers is plain-python/numpy bookkeeping and
+imports **without jax or flax** (locked by tests/test_imports.py): a
+router/scheduler tier can reason about page budgets on machines with no
+accelerator stack. The device helpers (arena init, dense gather views,
+page forks) import jax lazily at call time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+def _digest(tokens: np.ndarray) -> bytes:
+    """Stable content key for a token prefix (dtype-normalized so the same
+    ids hash equally regardless of the caller's integer width)."""
+    return hashlib.blake2b(
+        np.ascontiguousarray(tokens, np.int32).tobytes(), digest_size=16
+    ).digest()
+
+
+class PageAllocator:
+    """Refcounted free list over ``num_pages`` physical pages.
+
+    Page ids ``< reserved`` are never handed out (page 0 is the parking
+    page). A page is *free* iff its refcount is 0; ``alloc`` pops from the
+    free list and sets refcount 1, ``retain`` adds a reference (prefix-cache
+    sharing), ``release`` drops one and returns the page to the free list at
+    zero. The free list is LIFO so recently-hot pages are reused first.
+    """
+
+    def __init__(self, num_pages: int, reserved: int = 1):
+        if num_pages <= reserved:
+            raise ValueError(
+                f"num_pages ({num_pages}) must exceed reserved ({reserved})"
+            )
+        self.num_pages = int(num_pages)
+        self.reserved = int(reserved)
+        self.refs = [0] * num_pages
+        self._free = list(range(num_pages - 1, reserved - 1, -1))  # pop() -> lowest id
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.num_pages - self.reserved - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """One fresh page with refcount 1, or None when exhausted."""
+        if not self._free:
+            return None
+        page = self._free.pop()
+        self.refs[page] = 1
+        return page
+
+    def retain(self, page: int):
+        if self.refs[page] < 1:
+            raise ValueError(f"retain of free page {page}")
+        self.refs[page] += 1
+
+    def release(self, page: int) -> bool:
+        """Drop one reference; True when the page returned to the free list."""
+        if self.refs[page] < 1:
+            raise ValueError(f"release of free page {page}")
+        self.refs[page] -= 1
+        if self.refs[page] == 0:
+            self._free.append(page)
+            return True
+        return False
+
+    def shared(self, page: int) -> bool:
+        return self.refs[page] > 1
+
+
+@dataclass
+class PrefixEntry:
+    key: bytes
+    token_len: int
+    pages: tuple  # page ids covering [0, token_len)
+    hits: int = 0
+    last_used: int = 0
+
+
+class PrefixCache:
+    """Prompt-prefix -> shared-pages map, keyed by token-content hash.
+
+    Insertion registers every page-aligned prefix of a finished prompt
+    (plus the full, possibly partial-page prompt itself) as an entry; each
+    entry holds one allocator reference per covered page. Lookup walks the
+    cached lengths longest-first and returns the deepest entry whose token
+    hash matches the new prompt — O(distinct lengths) hash probes, no
+    token-by-token trie. Eviction is LRU at entry granularity; a page's
+    storage is reclaimed only when every referencing entry AND every
+    mapped slot has released it (the allocator's refcount).
+    """
+
+    def __init__(self, allocator: PageAllocator, page_size: int,
+                 max_entries: int = 512):
+        self.allocator = allocator
+        self.page_size = int(page_size)
+        self.max_entries = int(max_entries)
+        self.entries: dict = {}  # key bytes -> PrefixEntry
+        self._clock = 0
+        self.lookups = 0
+        self.hits = 0
+        self.hit_tokens = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _candidate_lengths(self) -> list:
+        return sorted({e.token_len for e in self.entries.values()}, reverse=True)
+
+    def lookup(self, prompt: np.ndarray, limit: Optional[int] = None):
+        """Longest cached prefix of ``prompt`` with ``token_len <= limit``.
+        Returns ``(hit_len, entry)`` or ``(0, None)``. The caller maps
+        ``entry.pages[: ceil(hit_len / page_size)]`` into its slot table
+        (retaining each) and prefills only ``prompt[hit_len:]`` — then
+        reports what it actually used via :meth:`record_hit` (the engine
+        may shrink or discard a hit whose tail plan would not fit the slot
+        or would cost more prefill dispatches than a cold admission, and
+        the hit-ratio gauges must reflect the final decision)."""
+        self.lookups += 1
+        n = int(prompt.size if limit is None else min(prompt.size, limit))
+        for length in self._candidate_lengths():
+            if length > n:
+                continue
+            entry = self.entries.get(_digest(prompt[:length]))
+            if entry is not None and entry.token_len == length:
+                return length, entry
+        return 0, None
+
+    def record_hit(self, tokens: int, entry: Optional[PrefixEntry] = None):
+        """Count a lookup hit that the caller actually committed to, with
+        the (possibly shrunk) number of prefix tokens served. LRU recency
+        moves here too: an entry whose hits are always declined must not
+        stay LRU-protected, pinning its pages over genuinely useful ones."""
+        if tokens > 0:
+            self.hits += 1
+            self.hit_tokens += int(tokens)
+            if entry is not None:
+                entry.hits += 1
+                entry.last_used = self._tick()
+
+    def insert(self, prompt: np.ndarray, pages) -> int:
+        """Register ``prompt`` (whose KV now lives in ``pages``, position
+        order) at every page-aligned prefix length plus its full length.
+        Each new entry retains its covered pages. Returns the number of
+        entries created."""
+        ps = self.page_size
+        n = int(prompt.size)
+        lengths = list(range(ps, n + 1, ps))
+        if n % ps:
+            lengths.append(n)  # partial-page tail: the COW-fork case
+        created = 0
+        for length in lengths:
+            key = _digest(prompt[:length])
+            hit = self.entries.get(key)
+            if hit is not None:
+                hit.last_used = self._tick()
+                continue
+            n_pages = -(-length // ps)
+            entry = PrefixEntry(
+                key=key, token_len=length, pages=tuple(int(p) for p in pages[:n_pages]),
+                last_used=self._tick(),
+            )
+            for p in entry.pages:
+                self.allocator.retain(p)
+            self.entries[key] = entry
+            created += 1
+        while len(self.entries) > self.max_entries and self.evict_lru():
+            pass
+        return created
+
+    def evict_lru(self) -> bool:
+        """Drop the least-recently-used entry (releasing its page refs);
+        False when the cache is empty. Called by the engine when the
+        allocator cannot satisfy an admission or a decode-time page grow."""
+        if not self.entries:
+            return False
+        key = min(self.entries, key=lambda k: self.entries[k].last_used)
+        entry = self.entries.pop(key)
+        for p in entry.pages:
+            self.allocator.release(p)
+        return True
+
+    def clear(self):
+        while self.evict_lru():
+            pass
+
+    @property
+    def hit_ratio(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+
+class NGramDrafter:
+    """Prompt-lookup speculative drafter (model-free, host-side).
+
+    ``propose(context, k)`` matches the last ``order`` tokens of the
+    request's prompt+generation history against earlier occurrences
+    (longest order first, most recent match first) and proposes the ``k``
+    tokens that followed; short/no matches pad by repeating the last token
+    (a padded draft that happens to match is still token-exact — accepted
+    tokens are always the *target model's* samples, drafts only decide how
+    many verify in one step). Accept-rate expectations: high for
+    templated/repetitive continuations (code, JSON, retrieval-grounded
+    text), near zero for high-entropy sampling — the verify step then
+    degrades to one-token-per-call, never to wrong tokens.
+    """
+
+    def __init__(self, order: int = 3, min_order: int = 1,
+                 lookback: int = 1024):
+        if order < 1 or min_order < 1 or min_order > order:
+            raise ValueError(f"bad n-gram orders ({order}, {min_order})")
+        if lookback < 2:
+            raise ValueError(f"lookback must be >= 2, got {lookback}")
+        self.order = int(order)
+        self.min_order = int(min_order)
+        # bound the per-proposal scan: without it the sliding-window match
+        # walks the FULL prompt+generation history every verify round,
+        # which is quadratic host work over a long generation
+        self.lookback = int(lookback)
+
+    def propose(self, context: np.ndarray, k: int) -> np.ndarray:
+        context = np.asarray(context, np.int32).reshape(-1)[-self.lookback:]
+        out = np.full((k,), int(context[-1]) if context.size else 0, np.int32)
+        if context.size < 2:
+            return out
+        for n in range(min(self.order, context.size - 1), self.min_order - 1, -1):
+            pat = context[-n:]
+            # most recent earlier occurrence of the n-gram
+            windows = np.lib.stride_tricks.sliding_window_view(context[:-1], n)
+            matches = np.nonzero((windows == pat).all(axis=1))[0]
+            if matches.size == 0:
+                continue
+            j = int(matches[-1])
+            cont = context[j + n : j + n + k]
+            out[: cont.size] = cont
+            return out
+        return out
+
+
+class PagedTables:
+    """Host mirror of the device page tables: one np row per slot plus the
+    allocated-entry count. Entries beyond ``alloc_count`` are parking-page
+    padding (gathered but masked, never written by an active slot)."""
+
+    def __init__(self, num_slots: int, pages_per_slot: int, parking: int = 0):
+        self.num_slots = int(num_slots)
+        self.pages_per_slot = int(pages_per_slot)
+        self.parking = int(parking)
+        self.rows = np.full((num_slots, pages_per_slot), parking, np.int32)
+        self.alloc_count = [0] * num_slots
+
+    def reset_slot(self, slot: int):
+        self.rows[slot] = self.parking
+        self.alloc_count[slot] = 0
+
+    def slot_pages(self, slot: int) -> list:
+        return [int(p) for p in self.rows[slot, : self.alloc_count[slot]]]
+
+
+# ---------------------------------------------------------------------------
+# device helpers (lazy jax: the bookkeeping above must import accelerator-free)
+# ---------------------------------------------------------------------------
+
+_KV_NDIM = 4  # paged K/V leaves are [num_pages, KVH, page_size, D] (+ layer axis)
+
+
+def _is_kv(leaf) -> bool:
+    return getattr(leaf, "ndim", 0) >= _KV_NDIM
+
+
+def _page_axis(leaf) -> int:
+    return leaf.ndim - _KV_NDIM
+
+
+def init_paged_arena(definition, params, num_slots: int, pages_per_slot: int,
+                     placer):
+    """All-zeros paged cache arena shaped by ``jax.eval_shape`` over the
+    paged decode apply — the paged twin of ``arena.init_arena`` (no compile,
+    no device compute, correct for any cache layout the family uses)."""
+    import jax
+    import jax.numpy as jnp
+
+    def shape_fn(p):
+        _, mutated = definition.apply(
+            {"params": placer(p)},
+            jnp.zeros((num_slots, 1), jnp.int32),
+            positions=jnp.zeros((num_slots, 1), jnp.int32),
+            use_cache=True,
+            decode=True,
+            cache_positions=jnp.zeros((num_slots,), jnp.int32),
+            page_table=jnp.zeros((num_slots, pages_per_slot), jnp.int32),
+            mutable=["cache"],
+        )
+        return mutated["cache"]
+
+    shapes = jax.eval_shape(shape_fn, params)
+    return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def dense_slot_view(arena, page_row, start):
+    """Batch-1 DENSE cache tree for one slot, gathered from its pages in
+    position order — what chunked prefill runs against, so the per-slot
+    scalar-``cache_index`` prefill path (and its chunk-exactness contract)
+    is reused verbatim on the paged arena. ``cache_index`` leaves become
+    ``start``, like ``arena.slot_view``. Traced-friendly."""
+    import jax
+    import jax.numpy as jnp
+
+    def take(leaf):
+        if not _is_kv(leaf):
+            return jnp.full(leaf.shape, start, leaf.dtype)
+        axis = _page_axis(leaf)
+        g = jnp.take(leaf, page_row, axis=axis)       # [..., P, KVH, ps, D]
+        g = jnp.moveaxis(g, axis, axis + 1)           # [..., KVH, P, ps, D]
+        shape = g.shape[: axis + 1] + (g.shape[axis + 1] * g.shape[axis + 2], g.shape[-1])
+        return jnp.expand_dims(g.reshape(shape), axis)  # [..., 1, KVH, P*ps, D]
+
+    return jax.tree_util.tree_map(take, arena)
+
+
+def scatter_slot_view(arena, view_tree, page_row):
+    """Write a mutated dense slot view back into the pages it was gathered
+    from (the inverse of :func:`dense_slot_view`). Duplicate ``page_row``
+    entries (parking padding) receive byte-identical writes — a prefill
+    chunk only mutates positions inside the slot's allocated span — so the
+    scatter's unspecified duplicate order cannot matter. Index leaves keep
+    the arena's value, mirroring ``arena.write_slot``."""
+    import jax
+    import jax.numpy as jnp
+
+    def put(leaf, view):
+        if not _is_kv(leaf):
+            return leaf
+        axis = _page_axis(leaf)
+        ps = leaf.shape[-2]
+        v = jnp.squeeze(view.astype(leaf.dtype), axis=axis)  # [..., KVH, P*ps, D]
+        shape = v.shape[: axis + 1] + (v.shape[axis + 1] // ps, ps, v.shape[-1])
+        v = jnp.moveaxis(v.reshape(shape), axis + 1, axis)   # [..., P, KVH, ps, D]
+        return leaf.at[(slice(None),) * axis + (page_row,)].set(v)
+
+    return jax.tree_util.tree_map(put, arena, view_tree)
+
+
+def fork_page(arena, src, dst):
+    """Copy physical page ``src`` -> ``dst`` across every K/V leaf (all
+    layers) — the copy-on-write fork. Traced ``src``/``dst``: one compiled
+    program forks any page."""
+    import jax
+
+    def copy(leaf):
+        if not _is_kv(leaf):
+            return leaf
+        axis = _page_axis(leaf)
+        page = jax.lax.dynamic_slice_in_dim(leaf, src, 1, axis=axis)
+        return jax.lax.dynamic_update_slice_in_dim(leaf, page, dst, axis=axis)
+
+    return jax.tree_util.tree_map(copy, arena)
+
+
+def set_table_row(tables, slot, row):
+    """Replace one slot's device page-table row (admission)."""
+    return tables.at[slot].set(row)
+
+
+def set_table_entry(tables, slot, idx, page):
+    """Point one table entry at a physical page (growth / fork)."""
+    return tables.at[slot, idx].set(page)

@@ -164,6 +164,14 @@ class RequestTracer:
         rec.pop("last_event", None)
         rec["finish_reason"] = reason
         rec["finish_unix_s"] = round(time.time(), 6)
+        # paged-arena / speculative attribution (engine-owned counters on
+        # the request; 0s on a flat-arena engine): how much of this
+        # request's TTFT the prefix cache saved, what it cost in pages,
+        # and how its draft tokens fared — what `accelerate-tpu trace`
+        # aggregates into per-burst hit/accept rates
+        for attr in ("prefix_hit", "pages_allocated", "spec_proposed",
+                     "spec_accepted"):
+            rec[attr] = int(getattr(req, attr, 0) or 0)
         total_s = (req.finish_t or time.perf_counter()) - req.submit_t
         rec["total_ms"] = round(total_s * 1e3, 3)
         rec["compiles_in_flight"] = self._compiles() - rec.pop("compiles_at_submit")

@@ -582,7 +582,8 @@ def _ttft_bench_matrix(cfg_name, prompt_len, tmpdir, variants=("bf16", "int8", "
     first_call) — the weather-free companion number the repo regresses on.
     Returns {variant: {"attempts": [...], "best", "p50", "fw_attempts":
     [...], "fw_best", "fw_p50", "phases": best attempt's breakdown}}."""
-    out = {v: {"attempts": [], "fw_attempts": [], "phases": {}} for v in variants}
+    out = {v: {"attempts": [], "fw_attempts": [], "phases": {},
+               "flush_attempts": []} for v in variants}
     raw = {v: [] for v in variants}
     for _ in range(rounds):
         for v in variants:
@@ -592,6 +593,7 @@ def _ttft_bench_matrix(cfg_name, prompt_len, tmpdir, variants=("bf16", "int8", "
             raw[v].append(t)
             out[v]["attempts"].append(round(t, 2))
             out[v]["fw_attempts"].append(round(_framework_ttft(ph), 2))
+            out[v]["flush_attempts"].append(round(ph.get("transfer_flush", 0.0), 2))
             if t <= min(raw[v]):
                 out[v]["phases"] = ph
     for v in variants:
@@ -601,6 +603,13 @@ def _ttft_bench_matrix(cfg_name, prompt_len, tmpdir, variants=("bf16", "int8", "
         fw = out[v]["fw_attempts"]
         out[v]["fw_best"] = min(fw)
         out[v]["fw_p50"] = round(float(np.median(fw)), 2)
+        # transfer_flush is the physical link and swings ~3x across rounds
+        # (7.7-21.7 s in the record): publish the MEDIAN of the >=3 attempts
+        # as the row of record — like the TTFT rows — and tag the spread so
+        # a reader can tell link weather from a real regression
+        fl = out[v]["flush_attempts"]
+        out[v]["flush_median"] = round(float(np.median(fl)), 2)
+        out[v]["flush_spread"] = [min(fl), max(fl)]
     return out
 
 
@@ -775,6 +784,189 @@ def _serving_slo_rows(batched: dict) -> dict:
         "serving_itl_p99": b["itl_p99_ms"],
         "serving_trace_overhead_pct": b["trace_overhead_pct"],
     }
+
+
+class _ReplayDrafter:
+    """Drafts from previously recorded output streams (prompt-lookup over
+    known continuations): the controlled-accept-rate drafter the spec bench
+    uses so `decode_spec_tokens_per_sec` measures the verify machinery, not
+    the luck of an n-gram match on a random-weight model."""
+
+    def __init__(self, streams):
+        self._streams = [np.asarray(s, np.int64) for s in streams]
+
+    def propose(self, context, k):
+        context = np.asarray(context, np.int64)
+        out = np.full((k,), int(context[-1]), np.int32)
+        for ref in self._streams:
+            if context.size <= ref.size and np.array_equal(
+                ref[: context.size], context
+            ):
+                cont = ref[context.size : context.size + k]
+                out[: cont.size] = cont
+                break
+        return out
+
+
+def _serving_paged_bench(cfg, prompt_len, *, flat_slots=4, page_size=16,
+                         max_new=16, spec_k=4, ttft_reqs=4):
+    """Paged-arena serving rows: slots per HBM byte vs the flat arena,
+    shared-prompt (prefix-cache) TTFT vs cold, and speculative-decode
+    throughput at a controlled accept rate.
+
+    - **slots/HBM**: a flat arena reserves ``max_cache_len`` of KV per slot;
+      the paged arena only binds pages as requests grow, so at the SAME KV
+      byte budget (flat_slots x pages_per_slot pages) it concurrently admits
+      2x the slots when requests use <= half a slot's capacity — asserted,
+      not assumed.
+    - **prefix TTFT**: one cold request populates the cache, then identical
+      templated prompts admit by mapping the shared pages and prefilling
+      only the tail — `serving_prefix_ttft_p50` vs `serving_cold_ttft_p50`.
+    - **spec decode**: the same engine shape with ``spec_draft_len`` on and
+      a replay drafter (recorded streams -> accept rate ~1) measures the
+      verify path's tokens/s vs the no-spec paged engine at matched batch;
+      the model-free n-gram drafter's accept rate on this model is reported
+      alongside as `spec_accept_rate_ngram`.
+    """
+    import dataclasses
+
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving import ServingEngine
+
+    need = prompt_len + max_new + spec_k
+    slot_pages = -(-need // page_size)        # pages one request binds
+    cap = 2 * slot_pages * page_size          # slot capacity = 2x a request
+    assert cap <= cfg.max_seq_len, (cap, cfg.max_seq_len)
+    cfg = dataclasses.replace(cfg, max_cache_len=cap)
+    model_def = DecoderLM(cfg)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len
+    )
+    params, _ = unbox_params(variables["params"])
+    params = jax.device_put(
+        jax.tree_util.tree_map(lambda x: x.astype(cfg.dtype), params)
+    )
+    rng = np.random.RandomState(0)
+    # a small bucket so a prefix-hit tail prefills a fraction of the cold
+    # plan's tokens, not just fewer of the same-size chunks
+    chunks = tuple(sorted({max(page_size, prompt_len // 4),
+                           prompt_len // 2, prompt_len}))
+    pages_per_slot = cap // page_size
+    num_pages = flat_slots * pages_per_slot + 1  # flat-equivalent KV (+parking)
+
+    def paged_engine(**kw):
+        kw.setdefault("num_slots", flat_slots)
+        kw.setdefault("max_cache_len", cap)
+        kw.setdefault("prefill_chunks", chunks)
+        kw.setdefault("page_size", page_size)
+        engine = ServingEngine(model_def, params, **kw)
+        engine.telemetry = None
+        # compile the whole program set up front: the TTFT comparison and
+        # the spec-vs-base tokens/s must measure steady-state dispatches,
+        # not who happened to pay the first compile
+        engine.warmup()
+        return engine
+
+    out = {"page_size": page_size, "max_cache_len": cap}
+
+    # -- slots per HBM byte: flat vs paged at equal KV budget --------------
+    flat = ServingEngine(model_def, params, num_slots=flat_slots,
+                         max_cache_len=cap, prefill_chunks=chunks)
+    flat.telemetry = None
+    out["flat_slots"] = flat_slots
+    out["arena_hbm_bytes_per_slot"] = {
+        "flat": flat.arena_bytes // flat_slots,
+    }
+    del flat
+    over = paged_engine(num_slots=2 * flat_slots, num_pages=num_pages,
+                        prefix_cache=False)
+    out["paged_slots"] = over.num_slots
+    out["arena_hbm_bytes_per_slot"]["paged"] = over.arena_bytes // over.num_slots
+    reqs = [
+        over.submit(rng.randint(0, cfg.vocab_size, (prompt_len,)),
+                    max_new_tokens=max_new, seed=i)
+        for i in range(2 * flat_slots)
+    ]
+    peak = 0
+    while over._queue or over._admitting is not None or over._slot_req:
+        over.step()
+        peak = max(peak, len(over._slot_req))
+    assert all(r.done for r in reqs)
+    out["paged_slots_admitted_at_flat_hbm"] = peak
+    assert peak >= 2 * flat_slots, (
+        f"paged arena admitted only {peak} concurrent slots at the flat "
+        f"arena's KV budget (expected >= {2 * flat_slots})"
+    )
+    del over
+
+    # -- prefix-cache TTFT: shared templated prompt vs cold ----------------
+    engine = paged_engine(num_slots=1, num_pages=4 * pages_per_slot + 1)
+    template = rng.randint(0, cfg.vocab_size, (prompt_len,))
+
+    def ttft_of(prompt, seed):
+        req = engine.submit(prompt, max_new_tokens=2, seed=seed)
+        engine.run()
+        return 1e3 * (req.first_token_t - req.submit_t), req
+
+    ttft_of(rng.randint(0, cfg.vocab_size, (prompt_len,)), 999)  # host warm
+    cold_ms = [ttft_of(rng.randint(0, cfg.vocab_size, (prompt_len,)), i)[0]
+               for i in range(ttft_reqs)]
+    ttft_of(template, 100)  # populate the cache with the template
+    shared = [ttft_of(template, 101 + i) for i in range(ttft_reqs)]
+    shared_ms = [t for t, _ in shared]
+    assert all(r.prefix_hit > 0 for _, r in shared)
+    out["serving_cold_ttft_p50_ms"] = round(float(np.median(cold_ms)), 3)
+    out["serving_prefix_ttft_p50_ms"] = round(float(np.median(shared_ms)), 3)
+    assert out["serving_prefix_ttft_p50_ms"] < out["serving_cold_ttft_p50_ms"], (
+        "prefix-cache hit did not beat cold prefill TTFT: "
+        f"{out['serving_prefix_ttft_p50_ms']} vs {out['serving_cold_ttft_p50_ms']} ms"
+    )
+    out["prefix_ttft_speedup"] = round(
+        out["serving_cold_ttft_p50_ms"] / out["serving_prefix_ttft_p50_ms"], 2
+    )
+    del engine
+
+    # -- speculative decode throughput at matched batch --------------------
+    prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(flat_slots)]
+
+    def decode_rate(engine):
+        got = engine.generate_batched(prompts, max_new_tokens=max_new,
+                                      seeds=range(flat_slots))
+        samples = list(engine._step_samples)
+        wall = sum(w for w, _, _ in samples)
+        toks = sum(t for _, t, _ in samples)
+        return (toks / wall if wall else None), got
+
+    base = paged_engine(prefix_cache=False)
+    base_tps, streams = decode_rate(base)
+    out["decode_paged_tokens_per_sec"] = round(base_tps, 1) if base_tps else None
+    del base
+    spec = paged_engine(prefix_cache=False, spec_draft_len=spec_k,
+                        drafter=_ReplayDrafter(streams))
+    spec_tps, spec_streams = decode_rate(spec)
+    for a, b in zip(streams, spec_streams):
+        np.testing.assert_array_equal(a, b)  # spec output is token-exact
+    m = spec.metrics()
+    out["decode_spec_tokens_per_sec"] = round(spec_tps, 1) if spec_tps else None
+    out["spec_accept_rate"] = round(m["serving/spec_accept_rate"], 4)
+    if m["serving/spec_accept_rate"] > 0.5 and base_tps and spec_tps:
+        assert spec_tps > base_tps, (
+            f"speculative decode ({spec_tps:.1f} tok/s) did not beat the "
+            f"plain paged engine ({base_tps:.1f} tok/s) at accept rate "
+            f"{m['serving/spec_accept_rate']:.2f}"
+        )
+        out["spec_speedup"] = round(spec_tps / base_tps, 2)
+    del spec
+    # the model-free n-gram drafter's accept rate on THIS model/traffic
+    ngram = paged_engine(prefix_cache=False, spec_draft_len=spec_k)
+    ngram.generate_batched(prompts, max_new_tokens=max_new,
+                           seeds=range(flat_slots))
+    out["spec_accept_rate_ngram"] = round(
+        ngram.metrics()["serving/spec_accept_rate"], 4
+    )
+    return out
 
 
 def _pipeline_mem_worker():
@@ -1010,6 +1202,22 @@ def main():
             extra["decode_batched_tokens_per_sec"]["batch8"] / single_tps, 2
         )
 
+        # paged arena + prefix cache + speculative decode (serving/pages.py):
+        # 2x slots at the flat arena's KV budget, near-zero TTFT for shared
+        # templated prompts, and the verify path's tokens/s — all asserted
+        extra["serving_paged"] = _serving_paged_bench(
+            ttft_cfg, 128, flat_slots=8, page_size=64, max_new=32, spec_k=4,
+        )
+        extra["serving_prefix_ttft_p50"] = extra["serving_paged"]["serving_prefix_ttft_p50_ms"]
+        extra["decode_spec_tokens_per_sec"] = extra["serving_paged"]["decode_spec_tokens_per_sec"]
+        extra["spec_accept_rate"] = extra["serving_paged"]["spec_accept_rate"]
+        extra["arena_hbm_bytes_per_slot"] = extra["serving_paged"]["arena_hbm_bytes_per_slot"]
+        # the transfer_flush noise rows (median-of-rounds + spread; the
+        # best-attempt phase breakdown above keeps the old shape)
+        for v in ("bf16", "int8", "int4"):
+            extra[f"dispatch_transfer_flush_{v}_median_s"] = matrix[v]["flush_median"]
+            extra[f"dispatch_transfer_flush_{v}_spread_s"] = matrix[v]["flush_spread"]
+
         # host-streamed row (VERDICT r5 missing #1: the flagship subsystem
         # proven with the host tier actually in the serving path): device
         # budget forced below the model, layer stack streams from pinned
@@ -1075,6 +1283,14 @@ def main():
         }
         extra["serving_admission_recompiles"] = max(rcs.values())
         extra.update(_serving_slo_rows(batched))
+        extra["serving_paged"] = _serving_paged_bench(
+            DecoderConfig.tiny(max_seq_len=256), 64, flat_slots=2,
+            page_size=16, max_new=8, spec_k=3, ttft_reqs=3,
+        )
+        extra["serving_prefix_ttft_p50"] = extra["serving_paged"]["serving_prefix_ttft_p50_ms"]
+        extra["decode_spec_tokens_per_sec"] = extra["serving_paged"]["decode_spec_tokens_per_sec"]
+        extra["spec_accept_rate"] = extra["serving_paged"]["spec_accept_rate"]
+        extra["arena_hbm_bytes_per_slot"] = extra["serving_paged"]["arena_hbm_bytes_per_slot"]
 
     print(
         f"[bench] backend={jax.default_backend()} tokens/s={tok_s:,.0f} "

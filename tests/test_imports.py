@@ -69,6 +69,20 @@ class TestNoEagerHeavyImports:
             "assert not heavy, f'explanatory-telemetry import pulled {heavy}'"
         )
 
+    def test_paged_kv_bookkeeping_stays_light(self):
+        """The paged-arena host layer (free list, refcounts, prefix-cache
+        hashing, n-gram drafter) is what a router/scheduler tier imports to
+        reason about page budgets — numpy-only, never jax/flax."""
+        _probe(
+            "import sys\n"
+            "import accelerate_tpu.serving.pages as pages\n"
+            "alloc = pages.PageAllocator(8)\n"
+            "cache = pages.PrefixCache(alloc, page_size=4)\n"
+            "pages.NGramDrafter()\n"
+            "heavy = {m for m in ('jax', 'flax') if m in sys.modules}\n"
+            "assert not heavy, f'serving.pages import pulled {heavy}'"
+        )
+
     def test_report_cli_module_stays_light(self):
         """`accelerate-tpu report` renders goodput/roofline/forensics
         artifacts on log-only machines — no jax at import."""

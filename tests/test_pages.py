@@ -1,0 +1,168 @@
+"""Host-side paged-KV bookkeeping (accelerate_tpu/serving/pages.py).
+
+Pure-python/numpy contracts — no jax, no device: the refcounted free
+list never leaks or double-frees, prefix-cache keying finds the longest
+cached page-aligned prefix (and the partial-tail entry) by content, LRU
+eviction releases page references, and the n-gram drafter proposes the
+continuation of the most recent matching n-gram. The engine-level twins
+(real arenas, real decode) live in tests/test_paged_serving.py.
+"""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.serving.pages import (
+    NGramDrafter,
+    PageAllocator,
+    PagedTables,
+    PrefixCache,
+)
+
+
+class TestPageAllocator:
+    def test_alloc_release_reuse_no_leak(self):
+        alloc = PageAllocator(9, reserved=1)
+        assert alloc.free_count == 8 and alloc.in_use == 0
+        # 100 alloc/release cycles must neither leak nor grow the free list
+        for _ in range(100):
+            pages = [alloc.alloc() for _ in range(8)]
+            assert None not in pages and alloc.alloc() is None  # exhausted
+            assert alloc.in_use == 8
+            for p in pages:
+                assert alloc.release(p)
+            assert alloc.free_count == 8 and alloc.in_use == 0
+
+    def test_reserved_pages_never_handed_out(self):
+        alloc = PageAllocator(4, reserved=2)
+        got = {alloc.alloc() for _ in range(2)}
+        assert got == {2, 3}
+
+    def test_refcounts_shared_release(self):
+        alloc = PageAllocator(4)
+        p = alloc.alloc()
+        alloc.retain(p)
+        assert alloc.shared(p)
+        assert not alloc.release(p)  # still referenced
+        assert alloc.release(p)      # now free
+        with pytest.raises(ValueError):
+            alloc.release(p)
+        with pytest.raises(ValueError):
+            alloc.retain(p)
+
+
+class TestPrefixCache:
+    def _cache(self, num_pages=64, ps=4, **kw):
+        alloc = PageAllocator(num_pages)
+        return alloc, PrefixCache(alloc, page_size=ps, **kw)
+
+    def _insert(self, alloc, cache, prompt):
+        n = -(-prompt.size // cache.page_size)
+        pages = [alloc.alloc() for _ in range(n)]
+        cache.insert(prompt, pages)
+        return pages
+
+    def test_longest_aligned_prefix_wins(self):
+        alloc, cache = self._cache()
+        prompt = np.arange(10, dtype=np.int32)  # pages: [0:4) [4:8) [8:10)
+        pages = self._insert(alloc, cache, prompt)
+        # identical prompt, limited to size-1 (the engine always re-prefills
+        # the last token for its logits): the 8-aligned entry must hit
+        hit, entry = cache.lookup(prompt, limit=prompt.size - 1)
+        assert hit == 8 and entry.pages == tuple(pages[:2])
+        # longer prompt sharing the full 10 tokens hits the partial entry
+        longer = np.concatenate([prompt, np.arange(50, 55, dtype=np.int32)])
+        hit, entry = cache.lookup(longer)
+        assert hit == 10 and entry.pages == tuple(pages)
+
+    def test_content_mismatch_misses(self):
+        alloc, cache = self._cache()
+        self._insert(alloc, cache, np.arange(8, dtype=np.int32))
+        other = np.arange(8, dtype=np.int32) + 1
+        assert cache.lookup(other) == (0, None)
+        assert cache.hit_ratio == 0.0
+
+    def test_insert_retains_and_evict_releases(self):
+        alloc, cache = self._cache()
+        prompt = np.arange(9, dtype=np.int32)
+        pages = self._insert(alloc, cache, prompt)
+        # entries at 4, 8 and 9 tokens: page0 x3, page1 x2, page2 x1 refs
+        assert alloc.refs[pages[0]] == 4  # 1 owner + 3 entries
+        # the owner (slot) releases; cache refs keep pages alive
+        for p in pages:
+            alloc.release(p)
+        assert alloc.in_use == 3
+        cache.clear()
+        assert alloc.in_use == 0 and not cache.entries
+
+    def test_lru_eviction_order_and_cap(self):
+        alloc, cache = self._cache(ps=4, max_entries=2)
+        a = np.arange(4, dtype=np.int32)
+        b = np.arange(4, dtype=np.int32) + 100
+        self._insert(alloc, cache, a)
+        self._insert(alloc, cache, b)
+        assert len(cache.entries) == 2
+        hit, e = cache.lookup(a)
+        cache.record_hit(hit, e)  # COMMITTED hit touches a -> b becomes LRU
+        self._insert(alloc, cache, np.arange(4, dtype=np.int32) + 200)
+        assert len(cache.entries) == 2
+        assert cache.lookup(a, limit=None)[0] == 4   # survived
+        assert cache.lookup(b, limit=None)[0] == 0   # evicted
+
+    def test_dtype_normalized_keys(self):
+        alloc, cache = self._cache()
+        self._insert(alloc, cache, np.arange(4, dtype=np.int64))
+        assert cache.lookup(np.arange(4, dtype=np.int32))[0] == 4
+
+    def test_hit_stats_count_committed_hits_only(self):
+        """lookup() returning an entry does not move the hit gauges: the
+        engine may shrink or decline the hit, and only record_hit() — with
+        the final token count — counts."""
+        alloc, cache = self._cache()
+        prompt = np.arange(8, dtype=np.int32)
+        self._insert(alloc, cache, prompt)
+        hit, entry = cache.lookup(prompt)
+        assert hit == 8 and entry is not None
+        assert cache.hits == 0 and cache.hit_tokens == 0
+        assert entry.hits == 0  # LRU recency is committed-hit based too
+        cache.record_hit(0, entry)   # declined: still a miss in the gauges
+        assert cache.hits == 0 and cache.hit_ratio == 0.0
+        assert entry.hits == 0
+        cache.record_hit(4, entry)   # committed after a shrink to 4 tokens
+        assert cache.hits == 1 and cache.hit_tokens == 4
+        assert entry.hits == 1
+
+
+class TestNGramDrafter:
+    def test_repetition_is_predicted(self):
+        d = NGramDrafter(order=2)
+        ctx = np.array([7, 8, 9, 7, 8], np.int32)
+        np.testing.assert_array_equal(d.propose(ctx, 3), [9, 7, 8])
+
+    def test_prefers_most_recent_match(self):
+        d = NGramDrafter(order=1)
+        ctx = np.array([5, 1, 5, 2, 5], np.int32)
+        assert d.propose(ctx, 1)[0] == 2  # continuation of the LAST earlier 5
+
+    def test_no_match_pads_with_last_token(self):
+        d = NGramDrafter(order=3)
+        ctx = np.array([1, 2, 3, 4], np.int32)
+        np.testing.assert_array_equal(d.propose(ctx, 2), [4, 4])
+
+    def test_short_context(self):
+        d = NGramDrafter()
+        np.testing.assert_array_equal(d.propose(np.array([3], np.int32), 2), [3, 3])
+
+    def test_fixed_length_output(self):
+        d = NGramDrafter(order=2)
+        ctx = np.array([1, 2, 1, 2], np.int32)
+        assert d.propose(ctx, 5).shape == (5,)
+
+
+class TestPagedTables:
+    def test_reset_restores_parking(self):
+        t = PagedTables(2, 4, parking=0)
+        t.rows[1, :2] = [5, 6]
+        t.alloc_count[1] = 2
+        assert t.slot_pages(1) == [5, 6]
+        t.reset_slot(1)
+        assert t.slot_pages(1) == [] and (t.rows[1] == 0).all()
