@@ -795,18 +795,46 @@ class TrainEngine:
             out["scale"] = dict(self.scale_state)
         return out
 
+    @staticmethod
+    def _own_restored_buffers(tree):
+        """Re-materialize restored leaves as executable outputs.
+
+        The step/update programs donate params and opt_state. A donated
+        buffer must be exclusively owned by its array; ``device_put``
+        results restored from a checkpoint do not always satisfy that
+        (scalar leaves can come out of jax's shared constant pool), and an
+        executable deserialized from the persistent compilation cache will
+        honor the donation where a freshly compiled CPU executable refuses
+        it — the runtime then reuses the donated storage for an unrelated
+        allocation while the aliased output still reads it (observed: adam
+        ``mu`` clobbered to the backward seed 1.0 one step after
+        ``load_state``). Copying through a compiled program yields
+        uniquely-owned buffers that are safe to donate.
+        """
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        idx = [i for i, leaf in enumerate(leaves) if isinstance(leaf, jax.Array)]
+        if idx:
+            picked = [leaves[i] for i in idx]
+            copier = jax.jit(
+                lambda xs: [jnp.copy(x) for x in xs],
+                out_shardings=[x.sharding for x in picked],
+            )
+            for i, fresh in zip(idx, copier(picked)):
+                leaves[i] = fresh
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
     def load_state_dict(self, state: dict):
-        self.params = jax.tree_util.tree_map(
+        self.params = self._own_restored_buffers(jax.tree_util.tree_map(
             lambda like, v: jax.device_put(jnp.asarray(v, like.dtype), like.sharding),
             self.params, state["params"],
-        )
+        ))
         if self.opt_state is not None and state.get("opt_state") is not None:
-            self.opt_state = jax.tree_util.tree_map(
+            self.opt_state = self._own_restored_buffers(jax.tree_util.tree_map(
                 lambda like, v: jax.device_put(jnp.asarray(v, like.dtype), like.sharding)
                 if isinstance(like, jax.Array)
                 else v,
                 self.opt_state, state["opt_state"],
-            )
+            ))
         self.step_count = int(state.get("step_count", 0))
         if "extra_state" in state:
             self.extra_state = replicate(state["extra_state"], self.mesh)
@@ -818,12 +846,12 @@ class TrainEngine:
 
     def load_optimizer_state(self, state: dict):
         if state.get("opt_state") is not None and self.opt_state is not None:
-            self.opt_state = jax.tree_util.tree_map(
+            self.opt_state = self._own_restored_buffers(jax.tree_util.tree_map(
                 lambda like, v: jax.device_put(jnp.asarray(v, like.dtype), like.sharding)
                 if isinstance(like, jax.Array)
                 else v,
                 self.opt_state, state["opt_state"],
-            )
+            ))
         if "step_count" in state:
             self.step_count = int(state["step_count"])
 

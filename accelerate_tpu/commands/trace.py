@@ -110,6 +110,8 @@ def summarize_requests(records: list) -> dict:
              "total_ms": StreamingHistogram(), "itl_ms": StreamingHistogram()}
     tokens = 0
     reasons: dict = {}
+    outcomes: dict = {}
+    preemptions = 0
     prefix_hits = prefix_tokens = prompt_tokens = 0
     spec_proposed = spec_accepted = pages = 0
     for rec in records:
@@ -122,6 +124,10 @@ def summarize_requests(records: list) -> dict:
         tokens += rec.get("tokens") or 0
         reason = rec.get("finish_reason", "?")
         reasons[reason] = reasons.get(reason, 0) + 1
+        outcome = rec.get("outcome")
+        if outcome:
+            outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        preemptions += rec.get("preemptions") or 0
         hit = rec.get("prefix_hit") or 0
         prefix_hits += 1 if hit else 0
         prefix_tokens += hit
@@ -130,6 +136,13 @@ def summarize_requests(records: list) -> dict:
         spec_accepted += rec.get("spec_accepted") or 0
         pages += rec.get("pages_allocated") or 0
     agg = {"requests": len(records), "tokens": tokens, "finish_reasons": reasons}
+    if outcomes:
+        # the definite-outcome contract: every submitted request landed as
+        # finished / shed / cancelled (an "evicted" here means a request
+        # was abandoned at close — the thing drain() exists to prevent)
+        agg["outcomes"] = outcomes
+    if preemptions:
+        agg["preemptions"] = preemptions
     if prefix_tokens or spec_proposed or pages:
         # paged-arena attribution: which share of requests (and of prompt
         # tokens) the prefix cache served, and how speculation fared
@@ -173,6 +186,11 @@ def _format_table(records: list, agg: dict) -> str:
             if k in agg
         )
     )
+    if "outcomes" in agg:
+        parts = [f"{k}={v}" for k, v in sorted(agg["outcomes"].items())]
+        if agg.get("preemptions"):
+            parts.append(f"preemptions={agg['preemptions']}")
+        lines.append("outcomes: " + ", ".join(parts))
     return "\n".join(lines)
 
 

@@ -18,6 +18,13 @@ _EXPORTS = {
     "NGramDrafter": "pages",
     "PageAllocator": "pages",
     "PrefixCache": "pages",
+    # the policy tier (scheduler.py) and the fault harness (faults.py)
+    # are jax-free like pages — a router tier imports them directly
+    "MultiTenantScheduler": "scheduler",
+    "PrefillBudgetController": "scheduler",
+    "SchedulerConfig": "scheduler",
+    "TenantConfig": "scheduler",
+    "FaultInjector": "faults",
 }
 
 __all__ = list(_EXPORTS)

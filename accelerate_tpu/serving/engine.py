@@ -55,19 +55,50 @@ from .pages import (
     set_table_entry,
     set_table_row,
 )
+from .scheduler import (
+    SHED_DRAINING,
+    SHED_PAGE_EXHAUSTED,
+    SHED_PAGE_PRESSURE,
+    MultiTenantScheduler,
+    PrefillBudgetController,
+    SchedulerConfig,
+)
 
 
-@dataclass
+class PagePressure(RuntimeError):
+    """Raised by the page allocator when nothing is left to evict —
+    callers translate it into a scheduling decision (preempt a victim,
+    shed a request) so a serving loop never wedges on it."""
+
+
+@dataclass(eq=False)
 class Request:
     """One generation request and its life-cycle state. ``tokens`` is the
     generated continuation (the prompt is not repeated); ``result()``
-    returns prompt + continuation like ``generate()`` does."""
+    returns prompt + continuation like ``generate()`` does.
+
+    ``eq=False``: requests are identities, not values. The generated
+    dataclass ``__eq__`` would compare the ``prompt`` arrays elementwise,
+    making ``queue.remove(req)`` raise (ambiguous array truth) past any
+    same-shape entry — which the scheduler's remove() would swallow as
+    "not queued", silently breaking cancel/timeout/shed.
+
+    Every submitted request reaches exactly one terminal ``outcome``:
+    ``"finished"`` (eos or token budget), ``"shed"`` (admission control /
+    load shedding / page exhaustion / drain — ``shed_reason`` says
+    which), or ``"cancelled"`` (``cancel()``, ``timeout_s`` expiry, or a
+    raising ``on_token`` callback). ``outcome`` is None while live;
+    ``finish_reason`` carries the finer-grained cause."""
 
     prompt: np.ndarray
     max_new_tokens: int
     rng: jax.Array
     on_token: Optional[Callable] = None
     id: int = -1
+    tenant: str = "default"
+    priority: int = 0
+    deadline_s: Optional[float] = None   # scheduling hint (EDF within class)
+    timeout_s: Optional[float] = None    # hard wall from submit to cancel
 
     # runtime state (engine-owned)
     tokens: list = field(default_factory=list)
@@ -76,7 +107,13 @@ class Request:
     submit_t: float = 0.0
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
+    outcome: Optional[str] = None        # finished | shed | cancelled
+    finish_reason: Optional[str] = None  # eos | budget | timeout | ...
+    shed_reason: Optional[str] = None
+    preemptions: int = 0
     _last_token_t: float = 0.0
+    _cancel: bool = False
+    _resume: Optional[dict] = None       # preempted: saved RNG row for re-admission
     # paged-arena attribution (request records carry these so
     # `accelerate-tpu trace`/`report` can attribute per-request TTFT wins)
     prefix_hit: int = 0        # prompt tokens served from the prefix cache
@@ -87,6 +124,15 @@ class Request:
     def result(self) -> np.ndarray:
         """[prompt + generated] token ids (the ``generate()`` contract)."""
         return np.concatenate([self.prompt, np.asarray(self.tokens, np.int32)])
+
+    def cancel(self) -> bool:
+        """Request cancellation; the engine frees the slot and pages at
+        the next scheduler iteration and the request lands in the log
+        with outcome ``cancelled``. False if already terminal."""
+        if self.done:
+            return False
+        self._cancel = True
+        return True
 
 
 class ServingEngine:
@@ -142,6 +188,8 @@ class ServingEngine:
         prefix_cache: bool = True,
         spec_draft_len: int = 0,
         drafter=None,
+        scheduler=None,
+        faults=None,
     ):
         from ..utils.compile_cache import (
             compile_event_counters,
@@ -257,6 +305,26 @@ class ServingEngine:
         self._rngs = jnp.zeros((self.num_slots, 2), jnp.uint32)
         self._active = np.zeros((self.num_slots,), bool)
 
+        # -- multi-tenant scheduler / fault injection ----------------------
+        # scheduler=None keeps the original FIFO deque; a SchedulerConfig
+        # or MultiTenantScheduler switches submit()/step() to the policy
+        # tier (weighted-fair queues, admission control, preemption, the
+        # ITL-SLO prefill-budget feedback loop — scheduler.py)
+        if isinstance(scheduler, SchedulerConfig):
+            scheduler = MultiTenantScheduler(scheduler)
+        self._sched: Optional[MultiTenantScheduler] = scheduler
+        self._controller = None
+        if scheduler is not None and scheduler.config.itl_slo_ms is not None:
+            self._controller = PrefillBudgetController(
+                scheduler.config.itl_slo_ms,
+                budget=scheduler.config.prefill_budget,
+                min_budget=scheduler.config.prefill_budget_min,
+                max_budget=scheduler.config.prefill_budget_max,
+            )
+        self._faults = faults
+        self._prefill_credit = 0.0
+        self._draining = False
+
         self._queue: deque = deque()
         self._free = list(range(self.num_slots))[::-1]  # pop() -> slot 0 first
         self._slot_req: dict = {}
@@ -277,9 +345,15 @@ class ServingEngine:
         # metrics
         self.step_count = 0
         self.requests_completed = 0
+        self.requests_shed = 0
+        self.requests_cancelled = 0
+        self.preemptions = 0
+        self.resumptions = 0
         self.generated_tokens = 0
         self._step_samples: deque = deque(maxlen=512)  # (wall_s, tokens, steps)
         self._itl: deque = deque(maxlen=2048)  # inter-token gaps, seconds
+        self._itl_emitted = 0   # lifetime gap count; the controller only
+        self._itl_observed = 0  # observes when these differ (fresh data)
         self._counters = compile_event_counters
         self._steady_mark = None
         self._exe_mem: Optional[dict] = None
@@ -487,7 +561,7 @@ class ServingEngine:
         deterministic, not a function of what traffic happened to arrive.
         All-inactive decode steps park their writes (see the step body), so
         warmup leaves no observable state behind."""
-        if self._slot_req or self._queue or self._admitting is not None:
+        if self._slot_req or self._queued_depth() or self._admitting is not None:
             raise RuntimeError("warmup() needs an idle engine")
         rng = jax.random.PRNGKey(0)
         # the eager per-admission ops, UNPACKED like _advance_admission does:
@@ -603,17 +677,39 @@ class ServingEngine:
         seed: int = 0,
         rng: Optional[jax.Array] = None,
         on_token: Optional[Callable] = None,
+        tenant: str = "default",
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        timeout_s: Optional[float] = None,
     ) -> Request:
         """Queue one request; returns its live :class:`Request` handle.
         ``rng``/``seed`` match ``generate(..., rng=...)``: the same seed
         yields the same tokens the single-stream loop would produce.
-        ``on_token(token_id, request)`` fires as each token is emitted."""
+        ``on_token(token_id, request)`` fires as each token is emitted.
+
+        With a scheduler attached, ``tenant``/``priority``/``deadline_s``
+        drive the weighted-fair, priority-classed queue, and admission
+        control applies: a submit past the queue watermarks returns a
+        request **already terminal with outcome ``shed``** (check
+        ``req.outcome``) instead of raising — backpressure is a value,
+        not an exception. ``timeout_s`` cancels the request (freeing its
+        slot and pages) if it has not finished that many seconds after
+        submit."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         cover = self._plan_cover(prompt.size)
+        if self._sched is not None and self._sched.config.preemption:
+            # a preemptible request must be re-admittable at ANY progress
+            # point: the worst-case replay (prompt + all generated tokens
+            # but the last) must itself chunk-plan within the slot, or a
+            # resume could fail to fit mid-flight when the prefix cache
+            # has nothing for it
+            cover = max(
+                cover, self._plan_cover(prompt.size + max_new_tokens - 1)
+            )
         # speculative verify writes up to spec_k positions past the last
         # sequential write, so spec reserves that much per-slot headroom
         need = prompt.size + max_new_tokens + self.spec_k
@@ -630,6 +726,10 @@ class ServingEngine:
             rng=rng if rng is not None else jax.random.PRNGKey(seed),
             on_token=on_token,
             id=next(self._next_id),
+            tenant=str(tenant or "default"),
+            priority=int(priority),
+            deadline_s=deadline_s,
+            timeout_s=timeout_s,
         )
         req.submit_t = time.perf_counter()
         tr = self._tracer()
@@ -637,6 +737,14 @@ class ServingEngine:
             # before the queue append: serve() admits from another thread,
             # and admission must find the tracer record already live
             tr.on_submit(req)
+        if self._draining:
+            self._shed(req, SHED_DRAINING)
+            return req
+        if self._sched is not None:
+            ok, reason = self._sched.admit(req)
+            if not ok:
+                self._shed(req, reason)
+            return req
         self._queue.append(req)
         return req
 
@@ -657,22 +765,90 @@ class ServingEngine:
             for p, s in zip(prompts, seeds)
         ]
         self.run()
+        # the batch API promises every output or a loud error — a request
+        # shed under page pressure (with no scheduler to preempt for it)
+        # must not come back as a silently truncated sequence
+        bad = [r for r in reqs if r.outcome != "finished"]
+        if bad:
+            raise RuntimeError(
+                f"generate_batched: {len(bad)}/{len(reqs)} requests did not "
+                f"finish ({sorted({r.outcome for r in bad})}; first: id="
+                f"{bad[0].id} shed_reason={bad[0].shed_reason}) — the arena "
+                "is overcommitted for this batch; raise num_pages/num_slots "
+                "or serve through submit() with a scheduler"
+            )
         return [r.result() for r in reqs]
 
     # -- scheduler ---------------------------------------------------------
 
+    def _queued_depth(self) -> int:
+        return self._sched.total_queued if self._sched is not None else len(self._queue)
+
+    def _pending(self) -> bool:
+        return bool(
+            self._queued_depth() or self._admitting is not None or self._slot_req
+        )
+
     def step(self) -> bool:
-        """One scheduler iteration: advance at most ONE prefill chunk, then
-        run one batched decode step over every active slot. Returns whether
-        any work happened (False = fully idle)."""
-        progressed = self._advance_admission()
+        """One scheduler iteration: reap cancels/timeouts, apply pressure
+        decisions (shed, preempt), advance prefill admission within the
+        ITL-budget, then run one batched decode step over every active
+        slot. Returns whether any work happened (False = fully idle)."""
+        if self._faults is not None:
+            self._faults.on_step(self)
+        if self._draining and self._queued_depth():
+            # request_drain() only sets the flag (it may fire from a
+            # signal handler); the queue shed always runs here, on the
+            # loop thread
+            self._shed_queue_for_drain()
+        progressed = self._reap()
+        if self._sched is not None:
+            progressed = self._shed_on_pressure() or progressed
+            progressed = self._maybe_preempt() or progressed
+            budget = (
+                self._controller.budget if self._controller is not None
+                else self._sched.config.prefill_budget
+            )
+            if not self._slot_req:
+                # throttling prefill protects live decodes' ITL; with
+                # none live there is nothing to protect — admit freely
+                budget = max(budget, 1.0)
+            self._prefill_credit = min(
+                self._prefill_credit + budget, max(1.0, budget)
+            )
+            while self._prefill_credit >= 1.0:
+                if not self._advance_admission():
+                    break
+                self._prefill_credit -= 1.0
+                progressed = True
+        else:
+            progressed = self._advance_admission() or progressed
         progressed = self._decode_once() or progressed
+        if (
+            self._controller is not None
+            and self._itl_emitted != self._itl_observed
+        ):
+            # gate on fresh gaps: idle iterations (serve() polling an
+            # empty engine) must not replay the last window's p99 into
+            # the controller at wall-clock rate
+            self._itl_observed = self._itl_emitted
+            p99, n = self._recent_itl_p99_ms()
+            self._controller.observe(p99, samples=n)
         return progressed
+
+    def _recent_itl_p99_ms(self, window: int = 128):
+        """p99 over the most recent ITL gaps — the live observation the
+        prefill-budget controller acts on (the lifetime histograms would
+        dilute a fresh regression under hours of healthy history)."""
+        if not self._itl:
+            return None, 0
+        recent = list(self._itl)[-window:]
+        return 1e3 * float(np.percentile(np.asarray(recent), 99)), len(recent)
 
     def run(self):
         """Drive :meth:`step` until queue, admissions and slots are idle."""
         try:
-            while self._queue or self._admitting is not None or self._slot_req:
+            while self._pending():
                 self.step()
         except Exception:
             self._flight_dump("serving_exception")
@@ -681,17 +857,296 @@ class ServingEngine:
     def serve(self, should_stop: Optional[Callable[[], bool]] = None, idle_sleep_s: float = 0.001):
         """Long-running loop: keep scheduling as requests arrive (from
         callbacks or another thread's ``submit``) until ``should_stop()``
-        returns True; idle iterations sleep ``idle_sleep_s``."""
+        returns True; idle iterations sleep ``idle_sleep_s``. A drain
+        request (:meth:`request_drain` — e.g. from the SIGTERM hook)
+        finishes the in-flight requests and returns even when
+        ``should_stop`` never fires."""
         try:
             while should_stop is None or not should_stop():
-                if not self.step():
-                    if should_stop is None:
-                        if not (self._queue or self._admitting or self._slot_req):
-                            return
+                busy = self.step()
+                if self._draining and not self._pending():
+                    return
+                if not busy:
+                    if should_stop is None and not self._pending():
+                        return
                     time.sleep(idle_sleep_s)
         except Exception:
             self._flight_dump("serving_exception")
             raise
+
+    # -- drain / shutdown ---------------------------------------------------
+
+    def request_drain(self):
+        """Flag-only drain: stop admitting (subsequent ``submit`` sheds)
+        and mark everything still queued for shedding at the top of the
+        next scheduler iteration; in-flight requests finish under
+        whatever loop is already driving :meth:`step`. Setting one flag
+        is the entire effect, so this is safe from a signal handler or
+        another thread even while the engine is mid-step — the queue
+        mutation itself always happens on the loop thread."""
+        self._draining = True
+
+    def _shed_queue_for_drain(self):
+        now = time.perf_counter()
+        for req in (self._sched.queued() if self._sched is not None
+                    else list(self._queue)):
+            if self._sched is not None:
+                self._sched.remove(req)
+            else:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    continue
+            req.shed_reason = SHED_DRAINING
+            self._terminate(req, now, "shed", "shed")
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful shutdown: stop admitting, shed the queue, run the
+        loop until every in-flight request finishes (or ``timeout_s``
+        passes — the stragglers are then cancelled), and flush telemetry.
+        Every request submitted before the drain ends with a definite
+        outcome; none is abandoned. Returns a small summary dict."""
+        self.request_drain()
+        self._shed_queue_for_drain()  # owner thread: shed synchronously
+        deadline = (
+            time.perf_counter() + timeout_s if timeout_s is not None else None
+        )
+        while self._pending():
+            if deadline is not None and time.perf_counter() > deadline:
+                now = time.perf_counter()
+                if self._admitting is not None:
+                    self._abort_admission(now, "cancelled", "drain_timeout")
+                for req in list(self._slot_req.values()):
+                    self._terminate(req, now, "cancelled", "drain_timeout")
+                break
+            self.step()
+        if self.telemetry is not None:
+            try:
+                self.telemetry.flush()
+            except Exception:
+                pass
+        return {
+            "completed": self.requests_completed,
+            "shed": self.requests_shed,
+            "cancelled": self.requests_cancelled,
+        }
+
+    # -- terminal transitions ----------------------------------------------
+
+    def _release_slot(self, req: Request):
+        if req.slot is None:
+            return
+        slot = req.slot
+        self._slot_req.pop(slot, None)
+        self._active[slot] = False
+        if self.page_size:
+            self._release_slot_pages(slot)
+        self._free.append(slot)
+        req.slot = None
+
+    def _terminate(self, req: Request, now: float, outcome: str, reason: str):
+        """The single exit for every request: exactly one terminal
+        outcome, slot+pages freed, counters and tracer fed."""
+        if req.done:
+            return
+        req.done = True
+        req.outcome = outcome
+        req.finish_reason = reason
+        req.finish_t = now
+        self._release_slot(req)
+        if outcome == "finished":
+            self.requests_completed += 1
+        elif outcome == "shed":
+            self.requests_shed += 1
+        else:
+            self.requests_cancelled += 1
+        tr = self._tracer()
+        if tr is not None:
+            tr.on_finish(req, reason)
+
+    def _shed(self, req: Request, reason: str):
+        req.shed_reason = reason
+        self._terminate(req, time.perf_counter(), "shed", "shed")
+
+    def _reap(self) -> bool:
+        """Process cancellations and ``timeout_s`` expiries — queued,
+        admitting and live alike. A cancelled/timed-out request frees its
+        slot and pages *now*, not at engine close."""
+        now = time.perf_counter()
+        progressed = False
+
+        def expired(req):
+            return (
+                req.timeout_s is not None and now - req.submit_t > req.timeout_s
+            )
+
+        for req in list(self._slot_req.values()):
+            if req._cancel or expired(req):
+                self._terminate(
+                    req, now, "cancelled",
+                    "cancelled" if req._cancel else "timeout",
+                )
+                progressed = True
+        if self._admitting is not None:
+            req = self._admitting[0]
+            if req._cancel or expired(req):
+                self._abort_admission(
+                    now, "cancelled", "cancelled" if req._cancel else "timeout"
+                )
+                progressed = True
+        queued = (
+            self._sched.queued() if self._sched is not None else list(self._queue)
+        )
+        for req in queued:
+            if req._cancel or expired(req):
+                if self._sched is not None:
+                    self._sched.remove(req)
+                else:
+                    try:
+                        self._queue.remove(req)
+                    except ValueError:
+                        continue
+                self._terminate(
+                    req, now, "cancelled",
+                    "cancelled" if req._cancel else "timeout",
+                )
+                progressed = True
+        return progressed
+
+    def _abort_admission(self, now: float, outcome: str, reason: str):
+        """Tear down a mid-prefill admission (cancel/timeout/page
+        exhaustion): the slot returns to the free list, its partially
+        prefilled pages are released, the request terminates."""
+        req, slot = self._admitting[0], self._admitting[1]
+        self._admitting = None
+        if self.page_size:
+            self._release_slot_pages(slot)
+        self._free.append(slot)
+        req.slot = None
+        if outcome == "shed":
+            req.shed_reason = reason if req.shed_reason is None else req.shed_reason
+            self._terminate(req, now, "shed", "shed")
+        else:
+            self._terminate(req, now, outcome, reason)
+
+    # -- pressure: shedding and preemption ----------------------------------
+
+    def _page_free_frac(self) -> float:
+        if not self.page_size:
+            return 1.0
+        usable = self.num_pages - self._allocator.reserved
+        return self._allocator.free_count / max(1, usable)
+
+    def _shed_on_pressure(self) -> bool:
+        """Watermark load shedding: when the paged arena's free fraction
+        drops below the configured watermark, drop the newest
+        lowest-priority queued request each step (queued work that could
+        not be admitted anyway) with a telemetry event."""
+        if not self.page_size or self._sched.total_queued == 0:
+            return False
+        # prefix-cache-held pages are reclaimable, not pressure: evict LRU
+        # entries first and only shed if the arena is still below the
+        # watermark (i.e. the pages are pinned by live slots or a fault
+        # injector, not the cache)
+        while (
+            self._page_free_frac() < self._sched.config.page_low_watermark
+            and self._prefix is not None
+            and self._prefix.evict_lru()
+        ):
+            pass
+        if self._page_free_frac() >= self._sched.config.page_low_watermark:
+            return False
+        # only shed queued work that really "could not be admitted
+        # anyway": a queued request that outranks a live slot is
+        # preemption's job (_maybe_preempt runs right after), so bound
+        # the pick to classes no live slot loses to — shedding the lone
+        # high-priority interactive request while low-priority batch
+        # slots pin the arena would invert priority
+        live = [int(r.priority) for r in self._slot_req.values()]
+        victim = self._sched.pick_shed(
+            max_priority=(min(live) + 1) if live else None
+        )
+        if victim is None:
+            return False
+        self._sched.shed(victim)
+        victim.shed_reason = SHED_PAGE_PRESSURE
+        self._terminate(victim, time.perf_counter(), "shed", "shed")
+        flight = getattr(self.telemetry, "flight", None)
+        if flight is not None:
+            flight.note("request_shed", request_id=victim.id,
+                        reason=SHED_PAGE_PRESSURE,
+                        free_frac=round(self._page_free_frac(), 4))
+        return True
+
+    def _maybe_preempt(self) -> bool:
+        """Page out the lowest-priority victim slot when a strictly
+        higher-priority request waits and no slot is free (at most one
+        preemption per scheduler iteration)."""
+        if (
+            self._free or self._admitting is not None or not self._slot_req
+            or self._sched.total_queued == 0
+        ):
+            return False
+        best = self._sched.peek_priority()
+        if best is None:
+            return False
+        victim = self._sched.pick_victim(self._slot_req.items(), best)
+        if victim is None:
+            return False
+        self._preempt(*victim)
+        return True
+
+    def _preempt(self, slot: int, req: Request):
+        """Suspend a live request: save its decode-RNG chain (a host
+        transfer — no compiled program), publish its KV pages to the
+        prefix cache, release the slot, and requeue it at the front of
+        its class. Re-admission replays prompt+generated via the prefix
+        cache (mostly hits) and restores the saved chain — token-exact
+        vs. an uninterrupted run, asserted in tests."""
+        # whole-array device_get then host index: jnp fancy-indexing one
+        # row would compile a gather, breaking the zero-recompile invariant
+        rng_row = np.asarray(jax.device_get(self._rngs))[slot].copy()
+        self._slot_req.pop(slot, None)
+        self._active[slot] = False
+        if self.page_size:
+            if self._prefix is not None and req.tokens:
+                replay = np.concatenate(
+                    [req.prompt, np.asarray(req.tokens[:-1], np.int32)]
+                )
+                # page out THROUGH the prefix cache: the entries hold the
+                # refs, so re-admission maps them back as cache hits (and
+                # LRU eviction can still reclaim them under real pressure)
+                self._prefix.insert(replay, self._tables_host.rows[slot])
+            self._release_slot_pages(slot)
+        self._free.append(slot)
+        req.slot = None
+        req.preemptions += 1
+        req._resume = {"rng": rng_row}
+        self.preemptions += 1
+        self._sched.requeue(req)
+        tr = self._tracer()
+        if tr is not None:
+            tr.on_preempt(req)
+        flight = getattr(self.telemetry, "flight", None)
+        if flight is not None:
+            flight.note("request_preempt", request_id=req.id, slot=slot,
+                        tokens=len(req.tokens))
+
+    def _relieve_pressure(self, req: Request, exclude_slot: int) -> bool:
+        """A live slot could not grow its pages: preempt a strictly
+        lower-priority victim (freeing its pages for this one) if the
+        scheduler allows it. False when no victim qualifies — the caller
+        sheds ``req`` instead of wedging."""
+        if self._sched is None:
+            return False
+        victim = self._sched.pick_victim(
+            ((s, r) for s, r in self._slot_req.items() if s != exclude_slot),
+            int(req.priority),
+        )
+        if victim is None:
+            return False
+        self._preempt(*victim)
+        return True
 
     # -- internals ---------------------------------------------------------
 
@@ -743,13 +1198,15 @@ class ServingEngine:
 
     def _alloc_page(self) -> int:
         """One fresh page, evicting LRU prefix-cache entries under
-        pressure. Exhaustion with nothing left to evict is an overcommit
-        misconfiguration, not a recoverable state — raise loudly."""
+        pressure. Exhaustion with nothing left to evict raises
+        :class:`PagePressure`, which the admission/decode paths translate
+        into a scheduling decision (preempt a victim, shed the request)
+        — never an exception out of ``step()``."""
         page = self._allocator.alloc()
         while page is None and self._prefix is not None and self._prefix.evict_lru():
             page = self._allocator.alloc()
         if page is None:
-            raise RuntimeError(
+            raise PagePressure(
                 f"paged KV arena exhausted ({self.num_pages} pages, "
                 f"{len(self._slot_req)} live slots): raise num_pages or "
                 "lower num_slots/max_new_tokens for this overcommit ratio"
@@ -784,25 +1241,26 @@ class ServingEngine:
             req.pages_allocated += 1
             self.page_forks += 1
 
-    def _paged_admit_plan(self, req: Request, slot: int) -> list:
-        """Map the longest cached prompt prefix into the slot's fresh page
-        table (refcount++ per shared page) and return the chunk plan for
-        the UNCACHED tail only — the prefix-cache TTFT win. At least the
-        prompt's final token always prefills: its logits seed the first
-        sampled token. Returns [(global_start, bucket), ...]."""
+    def _paged_admit_plan(self, req: Request, slot: int, seq: np.ndarray) -> list:
+        """Map the longest cached prefix of ``seq`` into the slot's fresh
+        page table (refcount++ per shared page) and return the chunk plan
+        for the UNCACHED tail only — the prefix-cache TTFT win. ``seq``
+        is the prompt on a fresh admission, or prompt+generated on a
+        preemption resume (whose pages the page-out published, so the
+        replay is mostly hits). At least the final token always prefills:
+        its logits seed the first sampled token (discarded on resume).
+        Returns [(global_start, bucket), ...]."""
         th = self._tables_host
         th.reset_slot(slot)
-        cold_chunks = len(self._plan_chunks(req.prompt.size))
+        cold_chunks = len(self._plan_chunks(seq.size))
         hit_len = 0
         entry = None
         if self._prefix is not None:
-            hit_len, entry = self._prefix.lookup(
-                req.prompt, limit=req.prompt.size - 1
-            )
+            hit_len, entry = self._prefix.lookup(seq, limit=seq.size - 1)
             # the tail plan must still fit the slot (its padded cover can
             # exceed the whole-prompt cover when the tail is tiny)
             while hit_len and (
-                hit_len + self._plan_cover(req.prompt.size - hit_len)
+                hit_len + self._plan_cover(seq.size - hit_len)
                 > self.max_cache_len
             ):
                 hit_len = max(0, hit_len - self.page_size)
@@ -811,7 +1269,7 @@ class ServingEngine:
             # 256 chunk but tail-plans as three 64s) is a TTFT loss, not a
             # win — decline it
             if hit_len and (
-                len(self._plan_chunks(req.prompt.size - hit_len)) > cold_chunks
+                len(self._plan_chunks(seq.size - hit_len)) > cold_chunks
             ):
                 hit_len = 0
             if hit_len == 0:
@@ -829,12 +1287,12 @@ class ServingEngine:
             # prefill chunks the cached prefix made unnecessary (TTFT
             # attribution; the cold plan is what a miss would have run)
             self.prefill_chunks_skipped += cold_chunks - len(
-                self._plan_chunks(req.prompt.size - hit_len)
+                self._plan_chunks(seq.size - hit_len)
             )
         self._page_tables = self._set_row(
             self._page_tables, slot, jnp.asarray(th.rows[slot])
         )
-        tail_plan = self._plan_chunks(req.prompt.size - hit_len)
+        tail_plan = self._plan_chunks(seq.size - hit_len)
         return [(hit_len + start, bucket) for start, bucket in tail_plan]
 
     def _insert_prefix(self, req: Request, slot: int):
@@ -862,32 +1320,97 @@ class ServingEngine:
             self._page_tables, slot, jnp.asarray(th.rows[slot])
         )
 
+    def _pop_next(self) -> Optional[Request]:
+        """Next request to admit: the scheduler's WFQ/priority pick, or
+        the FIFO head. Lazily skips requests that went terminal while
+        queued (cancel racing the pop)."""
+        while True:
+            if self._sched is not None:
+                req = self._sched.next_request()
+            else:
+                req = self._queue.popleft() if self._queue else None
+            if req is None or not req.done:
+                return req
+
+    def _replay_seq(self, req: Request) -> np.ndarray:
+        """The token sequence a preemption resume must re-prefill: the
+        prompt plus every generated token except the last (whose K/V the
+        next decode step writes — exactly the state the slot held when it
+        was paged out)."""
+        if not req.tokens:
+            return req.prompt
+        return np.concatenate(
+            [req.prompt, np.asarray(req.tokens[:-1], np.int32)]
+        )
+
     def _advance_admission(self) -> bool:
         tr = self._tracer()
         if self._admitting is None:
-            if not self._queue or not self._free:
+            if not self._free:
                 return False
-            req = self._queue.popleft()
+            req = self._pop_next()
+            if req is None:
+                return False
             slot = self._free.pop()
-            prefill_rng, decode_rng = jax.random.split(req.rng)
-            if self.page_size:
-                plan = self._paged_admit_plan(req, slot)
+            if req._resume is not None:
+                # preemption resume: replay prompt+generated (mostly
+                # prefix-cache hits — the page-out published those pages),
+                # discard the trailing sample, restore the saved RNG chain.
+                # req.rng is reused as the (ignored) prefill sample key: a
+                # concrete array, so no fresh eager op can recompile.
+                seq = self._replay_seq(req)
+                prefill_rng = req.rng
+                decode_rng = jnp.asarray(req._resume["rng"])
             else:
-                plan = self._plan_chunks(req.prompt.size)
-            self._admitting = [req, slot, plan, 0, prefill_rng, decode_rng]
+                seq = req.prompt
+                prefill_rng, decode_rng = jax.random.split(req.rng)
+            if self.page_size:
+                plan = self._paged_admit_plan(req, slot, seq)
+            else:
+                plan = self._plan_chunks(seq.size)
+            self._admitting = [req, slot, plan, 0, prefill_rng, decode_rng, seq]
             if tr is not None:
-                tr.on_admission(req, slot, time.perf_counter() - req.submit_t)
-        req, slot, plan, idx, prefill_rng, decode_rng = self._admitting
+                if req._resume is not None:
+                    tr.on_resume(req, slot)
+                else:
+                    tr.on_admission(req, slot, time.perf_counter() - req.submit_t)
+        req, slot, plan, idx, prefill_rng, decode_rng, seq = self._admitting
         start, bucket = plan[idx]
         chunk = np.zeros((1, bucket), np.int32)
-        seg = req.prompt[start:start + bucket]
+        seg = seq[start:start + bucket]
         chunk[0, : seg.size] = seg
-        last_idx = min(req.prompt.size, start + bucket) - 1 - start
+        last_idx = min(seq.size, start + bucket) - 1 - start
         chunk_dev = jnp.asarray(chunk)
         self._note_forensics(f"prefill_{bucket}", {"chunk_ids": chunk_dev})
+        if self._faults is not None:
+            self._faults.before_prefill(self)
         t0 = time.perf_counter()
         if self.page_size:
-            self._ensure_writable(req, slot, start, start + bucket - 1)
+            try:
+                self._ensure_writable(req, slot, start, start + bucket - 1)
+            except PagePressure:
+                # same ladder as live-slot growth (_grow_or_resolve): LRU
+                # eviction already failed inside _ensure_writable, so try
+                # paging out a strictly lower-priority victim before
+                # giving up — shedding the admission first would drop the
+                # highest-priority work under pressure. Only when no
+                # victim qualifies is the admission shed (never a raise
+                # out of step())
+                resolved = self._relieve_pressure(req, slot)
+                if resolved:
+                    try:
+                        self._ensure_writable(req, slot, start, start + bucket - 1)
+                    except PagePressure:
+                        resolved = False
+                if not resolved:
+                    self._abort_admission(
+                        time.perf_counter(), "shed", SHED_PAGE_EXHAUSTED
+                    )
+                    flight = getattr(self.telemetry, "flight", None)
+                    if flight is not None:
+                        flight.note("request_shed", request_id=req.id,
+                                    reason=SHED_PAGE_EXHAUSTED)
+                    return True
             self._arena, first = self._prefill_fn(bucket)(
                 self.params, self._arena, chunk_dev, slot, start, last_idx,
                 prefill_rng, page_tables=self._page_tables,
@@ -908,10 +1431,19 @@ class ServingEngine:
             return True
         # final chunk done -> the slot goes live with its first token
         self._admitting = None
-        if self.page_size:
+        resume = req._resume is not None
+        if self.page_size and not resume:
             self._insert_prefix(req, slot)
-        first_tok = int(jax.device_get(first))
-        length = int(req.prompt.size)
+        if resume:
+            # the replayed slot continues where it was paged out: last
+            # emitted token, restored chain, no new emission
+            first_tok = int(req.tokens[-1])
+            length = int(seq.size)
+            req._resume = None
+            self.resumptions += 1
+        else:
+            first_tok = int(jax.device_get(first))
+            length = int(req.prompt.size)
         self._tokens, self._lengths, self._rngs = self._admit_state(
             self._tokens, self._lengths, self._rngs, slot, first_tok, length,
             decode_rng,
@@ -919,6 +1451,14 @@ class ServingEngine:
         req.slot = slot
         self._slot_req[slot] = req
         self._active[slot] = True
+        if resume:
+            # the paged-out + requeued + replay wait is scheduling latency
+            # (the record's preemptions field owns it), not an inter-token
+            # gap: clearing the reference clock makes the first post-resume
+            # token gap-less, so one preemption cannot fake an ITL-p99
+            # breach and trip the AIMD controller into cutting the budget
+            req._last_token_t = 0.0
+            return True
         now = time.perf_counter()
         req.first_token_t = now
         if tr is not None:
@@ -933,7 +1473,7 @@ class ServingEngine:
         overshoot any request's token budget, else 1. Only these two values
         ever compile, keeping the program set bounded."""
         k = self.steps_per_call
-        if k <= 1 or self._admitting is not None or (self._queue and self._free):
+        if k <= 1 or self._admitting is not None or (self._queued_depth() and self._free):
             return 1
         remaining = min(
             req.max_new_tokens - len(req.tokens) for req in self._slot_req.values()
@@ -958,7 +1498,9 @@ class ServingEngine:
         # tokens, so build just the context tail — rebuilding the full
         # prompt+generation history every round is O(T^2) over a generation
         lb = int(getattr(self._drafter, "lookback", 0) or 0)
-        for slot, req in self._slot_req.items():
+        for slot, req in list(self._slot_req.items()):
+            if slot not in self._slot_req:
+                continue  # shed/preempted while relieving another slot
             gen = np.asarray(req.tokens[-lb:] if lb else req.tokens, np.int32)
             if lb and gen.size >= lb:
                 ctx = gen
@@ -967,7 +1509,10 @@ class ServingEngine:
                 ctx = np.concatenate([np.asarray(head, np.int32), gen])
             drafts[slot] = self._drafter.propose(ctx, k)
             pos = self._next_write_pos(req)
-            self._ensure_writable(req, slot, pos, pos + k)
+            if not self._grow_or_resolve(req, slot, pos, pos + k):
+                continue
+        if not self._slot_req:
+            return True  # every live slot was shed under page pressure
         drafts_dev = jnp.asarray(drafts)
         self._note_forensics(
             "spec_verify",
@@ -1010,6 +1555,27 @@ class ServingEngine:
                 costs.note_wall("spec_verify", wall)
         return True
 
+    def _grow_or_resolve(self, req: Request, slot: int, lo: int, hi: int) -> bool:
+        """Grow a live slot's pages for the next write range, resolving
+        page pressure by preempting a strictly-lower-priority victim (its
+        pages move here) or, when none qualifies, shedding ``req`` itself
+        — the one request outgrowing capacity pays, the loop never
+        raises. True when the slot is still live and writable."""
+        while True:
+            try:
+                self._ensure_writable(req, slot, lo, hi)
+                return True
+            except PagePressure:
+                if self._relieve_pressure(req, slot):
+                    continue
+                req.shed_reason = SHED_PAGE_EXHAUSTED
+                self._terminate(req, time.perf_counter(), "shed", "shed")
+                flight = getattr(self.telemetry, "flight", None)
+                if flight is not None:
+                    flight.note("request_shed", request_id=req.id,
+                                reason=SHED_PAGE_EXHAUSTED)
+                return False
+
     def _decode_once(self) -> bool:
         if not self._slot_req:
             return False
@@ -1017,9 +1583,15 @@ class ServingEngine:
             return self._spec_verify_once()
         k = self._burst_len()
         if self.page_size:
-            for slot, req in self._slot_req.items():
+            for slot, req in list(self._slot_req.items()):
+                if slot not in self._slot_req:
+                    continue  # shed/preempted while relieving another slot
                 pos = self._next_write_pos(req)
-                self._ensure_writable(req, slot, pos, pos + k - 1)
+                self._grow_or_resolve(req, slot, pos, pos + k - 1)
+            if not self._slot_req:
+                return True  # every live slot was shed under page pressure
+        if self._faults is not None:
+            self._faults.before_decode(self)
         self._note_forensics(
             "decode_step" if k == 1 else f"decode_burst{k}",
             {"tokens": self._tokens, "lengths": self._lengths,
@@ -1073,34 +1645,31 @@ class ServingEngine:
     def _emit(self, req: Request, token: int, now: float):
         req.tokens.append(token)
         self.generated_tokens += 1
+        if self._sched is not None:
+            self._sched.note_tokens(req.tenant, 1)
         gap = (now - req._last_token_t) if req._last_token_t else None
         if gap is not None:
             self._itl.append(gap)
+            self._itl_emitted += 1
             tr = self._tracer()
             if tr is not None:
                 tr.on_token(req, gap, len(req.tokens) - 1)
         req._last_token_t = now
         if req.on_token is not None:
-            req.on_token(token, req)
+            try:
+                req.on_token(token, req)
+            except Exception:
+                # a poisoned request (raising downstream consumer) must
+                # cost exactly one request, never the serving loop
+                self._terminate(req, now, "cancelled", "callback_error")
+                return
         if self.eos_token_id is not None and token == self.eos_token_id:
             self._finish(req, now, "eos")
         elif len(req.tokens) >= req.max_new_tokens:
             self._finish(req, now, "budget")
 
     def _finish(self, req: Request, now: float, reason: str = "budget"):
-        req.done = True
-        req.finish_t = now
-        if req.slot is not None:
-            self._slot_req.pop(req.slot, None)
-            self._active[req.slot] = False
-            if self.page_size:
-                self._release_slot_pages(req.slot)
-            self._free.append(req.slot)
-            req.slot = None
-        self.requests_completed += 1
-        tr = self._tracer()
-        if tr is not None:
-            tr.on_finish(req, reason)
+        self._terminate(req, now, "finished", reason)
 
     # -- metrics -----------------------------------------------------------
 
@@ -1157,12 +1726,28 @@ class ServingEngine:
         """Serving gauges, ``serving/``-namespaced for the telemetry rollup
         (TelemetrySession.attach_serving feeds these into every flush)."""
         out = {
-            "serving/queue_depth": len(self._queue),
+            "serving/queue_depth": self._queued_depth(),
             "serving/slot_occupancy": len(self._slot_req) / self.num_slots,
             "serving/requests_completed": self.requests_completed,
             "serving/generated_tokens": self.generated_tokens,
             "serving/arena_bytes": self.arena_bytes,
         }
+        if (
+            self._sched is not None
+            or self.requests_shed or self.requests_cancelled or self.preemptions
+        ):
+            out["serving/shed"] = self.requests_shed
+            out["serving/cancelled"] = self.requests_cancelled
+            out["serving/preemptions"] = self.preemptions
+            out["serving/resumptions"] = self.resumptions
+        if self._sched is not None:
+            out.update(self._sched.metrics())
+        if self._controller is not None:
+            out["serving/itl_budget"] = round(self._controller.budget, 4)
+            out["serving/itl_slo_breaches"] = self._controller.breaches
+            out["serving/itl_budget_adjustments"] = self._controller.adjustments
+        if self._draining:
+            out["serving/draining"] = True
         if self._step_samples:
             wall = sum(w for w, _, _ in self._step_samples)
             toks = sum(n for _, n, _ in self._step_samples)

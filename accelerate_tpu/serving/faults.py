@@ -1,0 +1,176 @@
+"""Deterministic fault injection for the serving engine.
+
+A scheduler that only ever sees healthy traffic is untested where it
+matters: the claim worth defending is that the engine **degrades
+gracefully** — bounded tenant interference, every request reaching a
+definite outcome, zero recompiles — while things go wrong. This module
+makes "things go wrong" reproducible:
+
+- **delayed steps** — injected sleeps before decode or prefill
+  dispatches (a straggler host, a noisy neighbor on the chip);
+- **page exhaustion** — the injector allocates and *holds* pages from
+  the engine's allocator for a step window, forcing the overcommit /
+  preemption / shed machinery to run without needing a giant traffic
+  burst;
+- **poisoned requests** — a request whose ``on_token`` callback raises
+  (a buggy downstream consumer); the engine must contain the blast
+  radius to that one request (outcome ``cancelled``), never the loop;
+- **tenant storms** — a callable fired at a chosen engine step,
+  typically a burst of ``submit()`` calls mid-flight (the mixed-tenant
+  isolation tests ride this).
+
+Everything is **seeded and scripted**: probabilistic faults draw from a
+private ``random.Random(seed)``, scheduled faults key on the engine's
+own ``step_count`` — the same seed and traffic replay the same fault
+sequence, so a failing burst test is a repro, not an anecdote. The
+module is plain python (no jax/flax — locked by tests/test_imports.py):
+the engine consults it with one attribute check per step when faults
+are off.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional
+
+
+class PoisonError(RuntimeError):
+    """What a poisoned request's ``on_token`` callback raises."""
+
+
+def poison_on_token(token, req):
+    """Drop-in ``on_token`` callback that blows up on the first token —
+    the canonical poisoned request. The engine must cancel the request
+    and keep serving."""
+    raise PoisonError(f"poisoned request {req.id} (token {token})")
+
+
+class FaultInjector:
+    """Scripted + seeded fault schedule, consulted by ``ServingEngine``.
+
+    Wire it with ``ServingEngine(..., faults=FaultInjector(seed=0)
+    .delay_decode(every=4, delay_s=0.002))``. Hooks the engine calls:
+    ``on_step(engine)`` once per scheduler iteration (storms fire,
+    page squeezes arm/release), ``before_decode(engine)`` /
+    ``before_prefill(engine)`` ahead of the respective dispatches
+    (delays sleep). ``log`` records every fired fault as
+    ``(step, kind, detail)`` so tests assert the schedule actually ran.
+    """
+
+    def __init__(self, seed: int = 0, sleep_fn: Callable[[float], None] = time.sleep):
+        self.rng = random.Random(seed)
+        self._sleep = sleep_fn
+        self._delays: list = []     # dicts: phase/every/prob/delay_s/start/stop
+        self._squeezes: list = []   # dicts: at_step/pages/hold_steps/held
+        self._storms: list = []     # (at_step, fn, fired)
+        self.log: list = []         # (step, kind, detail)
+
+    # -- schedule builders (chainable) -------------------------------------
+
+    def delay_decode(self, *, every: Optional[int] = None,
+                     prob: Optional[float] = None, delay_s: float = 0.002,
+                     start: int = 0, stop: Optional[int] = None) -> "FaultInjector":
+        """Sleep ``delay_s`` before decode dispatches — every Nth step,
+        or with probability ``prob`` per step (seeded)."""
+        if (every is None) == (prob is None):
+            raise ValueError("pass exactly one of every= / prob=")
+        self._delays.append(dict(phase="decode", every=every, prob=prob,
+                                 delay_s=float(delay_s), start=start, stop=stop))
+        return self
+
+    def delay_prefill(self, *, every: Optional[int] = None,
+                      prob: Optional[float] = None, delay_s: float = 0.002,
+                      start: int = 0, stop: Optional[int] = None) -> "FaultInjector":
+        """Sleep before prefill-chunk dispatches (makes prefill cost —
+        and therefore tenant interference — controlled and visible)."""
+        if (every is None) == (prob is None):
+            raise ValueError("pass exactly one of every= / prob=")
+        self._delays.append(dict(phase="prefill", every=every, prob=prob,
+                                 delay_s=float(delay_s), start=start, stop=stop))
+        return self
+
+    def squeeze_pages(self, *, at_step: int, pages: int,
+                      hold_steps: int = 8) -> "FaultInjector":
+        """At engine step ``at_step``, allocate and hold ``pages`` pages
+        from the engine's allocator (as many as it will give) for
+        ``hold_steps`` steps — synthetic page pressure."""
+        self._squeezes.append(dict(at_step=int(at_step), pages=int(pages),
+                                   hold_steps=int(hold_steps), held=None,
+                                   release_at=None, calls_left=None))
+        return self
+
+    def storm(self, *, at_step: int, fire: Callable) -> "FaultInjector":
+        """Run ``fire(engine)`` once when the engine reaches ``at_step``
+        — e.g. a burst of tenant-A ``submit()`` calls mid-flight."""
+        self._storms.append([int(at_step), fire, False])
+        return self
+
+    # -- engine hooks -------------------------------------------------------
+
+    def _maybe_sleep(self, phase: str, step: int):
+        for d in self._delays:
+            if d["phase"] != phase or step < d["start"]:
+                continue
+            if d["stop"] is not None and step >= d["stop"]:
+                continue
+            fire = (
+                step % d["every"] == 0 if d["every"] is not None
+                else self.rng.random() < d["prob"]
+            )
+            if fire:
+                self.log.append((step, f"delay_{phase}", d["delay_s"]))
+                self._sleep(d["delay_s"])
+
+    def before_decode(self, engine):
+        self._maybe_sleep("decode", engine.step_count)
+
+    def before_prefill(self, engine):
+        self._maybe_sleep("prefill", engine.step_count)
+
+    def on_step(self, engine):
+        """Step boundary: fire due storms, arm/release page squeezes."""
+        step = engine.step_count
+        for s in self._storms:
+            if not s[2] and step >= s[0]:
+                s[2] = True
+                self.log.append((step, "storm", s[0]))
+                s[1](engine)
+        alloc = getattr(engine, "_allocator", None)
+        for sq in self._squeezes:
+            if sq["held"] is None and sq["release_at"] is None and step >= sq["at_step"]:
+                if alloc is None:
+                    sq["release_at"] = step  # flat arena: nothing to squeeze
+                    continue
+                held = []
+                for _ in range(sq["pages"]):
+                    page = alloc.alloc()
+                    if page is None:
+                        break
+                    held.append(page)
+                sq["held"] = held
+                sq["release_at"] = step + sq["hold_steps"]
+                # engine.step_count only advances when a dispatch actually
+                # runs — a squeeze that starves every slot would freeze it
+                # and hold the pages forever. Bound the hold in on_step
+                # invocations too (generous, so the step-paced release
+                # wins whenever the engine is making progress).
+                sq["calls_left"] = 4 * sq["hold_steps"] + 16
+                self.log.append((step, "squeeze_pages", len(held)))
+            elif sq["held"] is not None:
+                if sq["calls_left"] is not None:
+                    sq["calls_left"] -= 1
+                if step >= sq["release_at"] or sq["calls_left"] <= 0:
+                    for page in sq["held"]:
+                        alloc.release(page)
+                    self.log.append((step, "release_pages", len(sq["held"])))
+                    sq["held"] = None
+
+    def release_all(self, engine):
+        """Return any still-held squeeze pages (test teardown)."""
+        alloc = getattr(engine, "_allocator", None)
+        for sq in self._squeezes:
+            if sq["held"] is not None and alloc is not None:
+                for page in sq["held"]:
+                    alloc.release(page)
+                sq["held"] = None

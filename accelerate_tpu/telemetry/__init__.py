@@ -122,6 +122,11 @@ class TelemetryConfig:
     flight_recorder: bool = True
     flight_events: int = 256               # bounded event ring capacity
     flight_hooks: bool = True              # dump on sys.excepthook / SIGTERM
+    # SIGTERM additionally requests a serving drain: attached engines stop
+    # admitting, shed their queues, and the live loop finishes in-flight
+    # requests — shutdown mid-burst leaves every request with a definite
+    # outcome instead of abandoning the queue (docs/serving.md)
+    drain_on_sigterm: bool = True
     # trigger-based jax.profiler capture windows (docs/profiling.md)
     profile_steps: Optional[tuple] = None  # (start, stop) step window
     profile_window_steps: int = 16         # auto-armed window length, in steps
@@ -297,6 +302,7 @@ class TelemetrySession:
             self.flight = FlightRecorder(
                 self, dump_dir=self.trace_dir, capacity=config.flight_events,
                 process_index=self.process_index,
+                drain_serving=config.drain_on_sigterm,
             )
             if config.flight_hooks:
                 self.flight.install_hooks()
@@ -416,6 +422,21 @@ class TelemetrySession:
             self.flight.dump("watchdog_stall", extra={"stall_report": report})
         if self.capture is not None:
             self.capture.arm("watchdog_stall")
+
+    def request_drain_serving(self):
+        """Ask every attached serving engine to drain (flag-only: stop
+        admitting, shed the queue; the loop already driving the engine
+        finishes the in-flight requests). Called from the flight
+        recorder's SIGTERM hook — pure host bookkeeping, safe from a
+        signal handler."""
+        for ref in list(self._serving):
+            engine = ref()
+            if engine is None:
+                continue
+            try:
+                engine.request_drain()
+            except Exception:
+                pass
 
     def executable_memory(self) -> dict:
         """Live-executable ``memory_analysis`` from every attached serving

@@ -44,10 +44,12 @@ class FlightRecorder:
     """Bounded event ring + debug-bundle dumper for one telemetry session."""
 
     def __init__(self, session, dump_dir: Optional[str] = None,
-                 capacity: int = 256, process_index: int = 0):
+                 capacity: int = 256, process_index: int = 0,
+                 drain_serving: bool = True):
         self.session = session
         self.dump_dir = dump_dir
         self.process_index = process_index
+        self.drain_serving = drain_serving
         self.ring: deque = deque(maxlen=max(8, int(capacity)))
         self.dump_count = 0
         self.last_bundle_path: Optional[str] = None
@@ -122,6 +124,16 @@ class FlightRecorder:
             self.dump("sigterm")
         except Exception:
             pass
+        if self.drain_serving and self.session is not None:
+            # request (not run) a serving drain: attached engines stop
+            # admitting and shed their queues right here — host-side
+            # bookkeeping only — and whatever loop is driving them
+            # finishes the in-flight requests before exiting, so shutdown
+            # mid-burst leaves every request with a definite outcome
+            try:
+                self.session.request_drain_serving()
+            except Exception:
+                pass
         prev = self._prev_sigterm
         if callable(prev):
             prev(signum, frame)

@@ -77,6 +77,8 @@ class RequestTracer:
             "request_id": req.id,
             "prompt_len": int(req.prompt.size),
             "max_new_tokens": int(req.max_new_tokens),
+            "tenant": getattr(req, "tenant", "default"),
+            "priority": int(getattr(req, "priority", 0) or 0),
             "submit_unix_s": round(time.time(), 6),
             "state": "queued",
             "slot": None,
@@ -126,6 +128,29 @@ class RequestTracer:
                           args={"request_id": req.id, "slot": slot,
                                 "start": start, "bucket": bucket})
 
+    def on_preempt(self, req):
+        """A live request was paged out (its slot and KV pages released,
+        its RNG chain saved); it re-enters the queue at the front of its
+        class. The record keeps a preemption count so a slow request's
+        latency is attributable to scheduling, not the chip."""
+        rec = self._live.get(req.id)
+        if rec is None:
+            return
+        rec["state"] = "preempted"
+        rec["slot"] = None
+        rec["preemptions"] = rec.get("preemptions", 0) + 1
+        rec["last_event"] = ("preempt", time.time())
+
+    def on_resume(self, req, slot: int):
+        """A preempted request was re-admitted (replay prefill done, chain
+        restored) and is decoding again."""
+        rec = self._live.get(req.id)
+        if rec is None:
+            return
+        rec["state"] = "decode"
+        rec["slot"] = int(slot)
+        rec["last_event"] = ("resume", time.time())
+
     def on_first_token(self, req, ttft_s: float):
         rec = self._live.get(req.id)
         if rec is None:
@@ -163,6 +188,13 @@ class RequestTracer:
         rec.pop("state", None)
         rec.pop("last_event", None)
         rec["finish_reason"] = reason
+        # the definite-outcome contract: finished | shed | cancelled (the
+        # engine sets it at the single terminal transition; "finished" is
+        # inferred for callers driving the tracer without an outcome)
+        rec["outcome"] = getattr(req, "outcome", None) or "finished"
+        shed_reason = getattr(req, "shed_reason", None)
+        if shed_reason:
+            rec["shed_reason"] = shed_reason
         rec["finish_unix_s"] = round(time.time(), 6)
         # paged-arena / speculative attribution (engine-owned counters on
         # the request; 0s on a flat-arena engine): how much of this
@@ -208,6 +240,7 @@ class RequestTracer:
                 rec.pop("state", None)
                 rec.pop("last_event", None)
                 rec["finish_reason"] = "evicted"
+                rec["outcome"] = "evicted"
                 rec["finish_unix_s"] = round(now, 6)
                 rec["total_ms"] = round((now - rec["submit_unix_s"]) * 1e3, 3)
                 rec["compiles_in_flight"] = (

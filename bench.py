@@ -969,6 +969,114 @@ def _serving_paged_bench(cfg, prompt_len, *, flat_slots=4, page_size=16,
     return out
 
 
+def _serving_isolation_bench(cfg, prompt_len, *, page_size=16, num_slots=2,
+                             storm_reqs=4, b_reqs=4, max_new=12,
+                             chunk_delay_s=0.004):
+    """Multi-tenant isolation rows (scheduler.py wired into the engine):
+    a seeded tenant-A prefill storm lands mid-flight while tenant B
+    ('interactive', priority 5) decodes short prompts — published as the
+    clean vs under-storm ITL p99 of B and their ratio, plus the scheduling
+    actions (preemptions, sheds, final ITL budget) the run took.
+
+    Injected per-chunk prefill delays (FaultInjector, seeded) make chunk
+    cost deterministic, so the degradation factor measures *scheduling*
+    interference — how many storm chunks the ITL-budget controller lets
+    between B's tokens — not host noise. The definite-outcome contract is
+    asserted: every request in both waves terminates finished/shed.
+    """
+    import dataclasses
+
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving import (
+        FaultInjector,
+        SchedulerConfig,
+        ServingEngine,
+    )
+
+    cap = -(-(2 * prompt_len + max_new) // page_size) * page_size
+    cfg = dataclasses.replace(cfg, max_cache_len=min(cfg.max_seq_len, cap))
+    model_def = DecoderLM(cfg)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len
+    )
+    params, _ = unbox_params(variables["params"])
+    params = jax.device_put(
+        jax.tree_util.tree_map(lambda x: x.astype(cfg.dtype), params)
+    )
+    chunk = max(page_size, prompt_len // 4)
+    slo_ms = 1e3 * chunk_delay_s + 10.0
+
+    def wave(storm: bool):
+        rng = np.random.RandomState(42)
+        stamps = {}
+
+        def stamp(tok, req):
+            stamps.setdefault(req.id, []).append(time.perf_counter())
+
+        faults = FaultInjector(seed=1).delay_prefill(
+            every=1, delay_s=chunk_delay_s
+        )
+        a_prompts = [rng.randint(0, cfg.vocab_size, (2 * prompt_len,))
+                     for _ in range(storm_reqs)]
+        reqs = []
+        if storm:
+            faults.storm(at_step=2, fire=lambda eng: reqs.extend(
+                eng.submit(p, max_new_tokens=3, seed=100 + i,
+                           tenant="batch", priority=0)
+                for i, p in enumerate(a_prompts)
+            ))
+        engine = ServingEngine(
+            model_def, params, num_slots=num_slots,
+            max_cache_len=cfg.max_cache_len, prefill_chunks=(chunk,),
+            page_size=page_size,
+            scheduler=SchedulerConfig(itl_slo_ms=slo_ms), faults=faults,
+        )
+        engine.telemetry = None
+        engine.warmup()
+        engine.mark_steady()
+        b_prompts = [rng.randint(0, cfg.vocab_size, (prompt_len // 2,))
+                     for _ in range(b_reqs)]
+        reqs += [
+            engine.submit(p, max_new_tokens=max_new, seed=i,
+                          tenant="interactive", priority=5, on_token=stamp)
+            for i, p in enumerate(b_prompts)
+        ]
+        engine.run()
+        assert all(r.done and r.outcome in ("finished", "shed")
+                   for r in reqs), "a burst request never terminated"
+        assert engine.admission_recompiles == 0, (
+            "storm scheduling recompiled post-steady"
+        )
+        gaps = [
+            1e3 * (b - a)
+            for req in reqs if req.tenant == "interactive"
+            for a, b in zip(stamps.get(req.id, []), stamps.get(req.id, [])[1:])
+        ]
+        return float(np.percentile(gaps, 99)), reqs, engine
+
+    p99_base, _, _ = wave(storm=False)
+    p99_storm, reqs, engine = wave(storm=True)
+    m = engine.metrics()
+    return {
+        "itl_slo_ms": round(slo_ms, 2),
+        "itl_p99_clean_ms": round(p99_base, 3),
+        "itl_p99_storm_ms": round(p99_storm, 3),
+        "storm_degradation_x": round(p99_storm / max(1e-9, p99_base), 2),
+        "interactive_finished": sum(
+            r.outcome == "finished" for r in reqs if r.tenant == "interactive"
+        ),
+        "storm_finished": sum(
+            r.outcome == "finished" for r in reqs if r.tenant == "batch"
+        ),
+        "storm_shed": sum(
+            r.outcome == "shed" for r in reqs if r.tenant == "batch"
+        ),
+        "preemptions": engine.preemptions,
+        "itl_budget_final": m.get("serving/itl_budget"),
+    }
+
+
 def _pipeline_mem_worker():
     """Compiled temp-memory (stash + belts) for gpipe-under-AD vs the manual
     1F1B schedule at M=4S, on the 8-device CPU sim (the schedule's win is a
@@ -1212,6 +1320,15 @@ def main():
         extra["decode_spec_tokens_per_sec"] = extra["serving_paged"]["decode_spec_tokens_per_sec"]
         extra["spec_accept_rate"] = extra["serving_paged"]["spec_accept_rate"]
         extra["arena_hbm_bytes_per_slot"] = extra["serving_paged"]["arena_hbm_bytes_per_slot"]
+
+        # multi-tenant isolation under a seeded prefill storm (scheduler):
+        # tenant B's ITL p99 clean vs under-storm, preempt/shed actions
+        extra["serving_isolation"] = _serving_isolation_bench(
+            ttft_cfg, 128, page_size=64, num_slots=4,
+        )
+        extra["serving_isolation_degradation_x"] = (
+            extra["serving_isolation"]["storm_degradation_x"]
+        )
         # the transfer_flush noise rows (median-of-rounds + spread; the
         # best-attempt phase breakdown above keeps the old shape)
         for v in ("bf16", "int8", "int4"):
@@ -1291,6 +1408,13 @@ def main():
         extra["decode_spec_tokens_per_sec"] = extra["serving_paged"]["decode_spec_tokens_per_sec"]
         extra["spec_accept_rate"] = extra["serving_paged"]["spec_accept_rate"]
         extra["arena_hbm_bytes_per_slot"] = extra["serving_paged"]["arena_hbm_bytes_per_slot"]
+        extra["serving_isolation"] = _serving_isolation_bench(
+            DecoderConfig.tiny(max_seq_len=256), 32, page_size=16,
+            num_slots=2, storm_reqs=3, b_reqs=3, max_new=8,
+        )
+        extra["serving_isolation_degradation_x"] = (
+            extra["serving_isolation"]["storm_degradation_x"]
+        )
 
     print(
         f"[bench] backend={jax.default_backend()} tokens/s={tok_s:,.0f} "

@@ -388,6 +388,33 @@ class TestCheckpointing:
         for k in params_run1:
             np.testing.assert_allclose(params_run1[k], params_run2[k], rtol=1e-6)
 
+    def test_training_continues_identically_warm_compile_cache(self, tmp_path):
+        """test_training_continues_identically with every executable forced
+        through the persistent compilation cache. The post-restore update is
+        then a cache-DESERIALIZED executable donating device_put-restored
+        buffers; without TrainEngine._own_restored_buffers the runtime
+        reuses the donated storage for an unrelated allocation and the
+        aliased output reads it back corrupted (observed: adam ``mu``
+        clobbered to the backward seed 1.0 one step after ``load_state``,
+        params then diverging non-deterministically)."""
+        prev_dir = jax.config.jax_compilation_cache_dir
+        prev_min_time = jax.config.jax_persistent_cache_min_compile_time_secs
+        prev_min_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+
+        def _cache_config(cache_dir, min_time, min_size):
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", min_time)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", min_size)
+            from jax.experimental.compilation_cache import compilation_cache
+
+            compilation_cache.reset_cache()
+
+        _cache_config(str(tmp_path / "xla_cache"), 0.0, 0)
+        try:
+            self.test_training_continues_identically(tmp_path)
+        finally:
+            _cache_config(prev_dir, prev_min_time, prev_min_size)
+
     def test_register_for_checkpointing(self, tmp_path):
         class Counter:
             def __init__(self):

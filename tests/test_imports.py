@@ -83,6 +83,22 @@ class TestNoEagerHeavyImports:
             "assert not heavy, f'serving.pages import pulled {heavy}'"
         )
 
+    def test_scheduler_policy_tier_stays_light(self):
+        """The multi-tenant scheduler (WFQ, quotas, admission control,
+        the ITL-budget controller) and the fault-injection harness are
+        pure host policy — a router tier runs the same admission/shed
+        math on machines with no accelerator stack."""
+        _probe(
+            "import sys\n"
+            "import accelerate_tpu.serving.scheduler as sched\n"
+            "import accelerate_tpu.serving.faults as faults\n"
+            "s = sched.MultiTenantScheduler(sched.SchedulerConfig())\n"
+            "sched.PrefillBudgetController(25.0)\n"
+            "faults.FaultInjector(seed=0).delay_decode(every=4)\n"
+            "heavy = {m for m in ('jax', 'flax') if m in sys.modules}\n"
+            "assert not heavy, f'scheduler/faults import pulled {heavy}'"
+        )
+
     def test_report_cli_module_stays_light(self):
         """`accelerate-tpu report` renders goodput/roofline/forensics
         artifacts on log-only machines — no jax at import."""

@@ -1,0 +1,465 @@
+"""Multi-tenant scheduler policy layer (accelerate_tpu/serving/scheduler.py)
+and the fault-injection harness (serving/faults.py) — pure host-side units,
+no jax, no engine.
+
+The contracts of record:
+- weighted-fair queuing: tenants drain in proportion to their weights;
+- strict priority classes above the fair share; EDF within a class;
+- token quotas bound a tenant's *contended* share (work-conserving:
+  an over-quota tenant still runs when nobody else has work);
+- admission control is a value, not an exception: bounded queues reject
+  with a shed reason;
+- shed/victim picks are lowest-priority-first and deterministic;
+- the prefill-budget controller is AIMD against the ITL-p99 SLO;
+- the fault injector replays the same schedule for the same seed.
+"""
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.serving.faults import FaultInjector
+from accelerate_tpu.serving.scheduler import (
+    SHED_QUEUE_FULL,
+    SHED_TENANT_QUEUE_FULL,
+    MultiTenantScheduler,
+    PrefillBudgetController,
+    SchedulerConfig,
+    TenantConfig,
+)
+
+
+@dataclass
+class FakeReq:
+    """The slice of Request the scheduler reads."""
+
+    id: int
+    tenant: str = "default"
+    priority: int = 0
+    deadline_s: float = None
+    prompt: np.ndarray = field(default_factory=lambda: np.zeros(8, np.int32))
+    max_new_tokens: int = 8
+    tokens: list = field(default_factory=list)
+    done: bool = False
+
+
+def _mk(sched, id, **kw):
+    req = FakeReq(id=id, **kw)
+    ok, reason = sched.admit(req)
+    assert ok, reason
+    return req
+
+
+class TestWeightedFairQueues:
+    def test_equal_weights_interleave(self):
+        sched = MultiTenantScheduler()
+        for i in range(4):
+            _mk(sched, 10 + i, tenant="a")
+            _mk(sched, 20 + i, tenant="b")
+        order = [sched.next_request().tenant for _ in range(8)]
+        # WFQ with equal weights and equal costs alternates perfectly
+        assert order.count("a") == order.count("b") == 4
+        for i in range(0, 8, 2):
+            assert {order[i], order[i + 1]} == {"a", "b"}
+
+    def test_weights_skew_the_share(self):
+        cfg = SchedulerConfig(tenants={
+            "heavy": TenantConfig(weight=3.0), "light": TenantConfig(weight=1.0),
+        })
+        sched = MultiTenantScheduler(cfg)
+        for i in range(12):
+            _mk(sched, 100 + i, tenant="heavy")
+            _mk(sched, 200 + i, tenant="light")
+        first8 = [sched.next_request().tenant for _ in range(8)]
+        # 3:1 weights -> the heavy tenant gets ~3/4 of the early picks
+        assert first8.count("heavy") == 6
+
+    def test_idle_tenant_does_not_bank_credit(self):
+        """A tenant waking from idle must not replay the virtual time it
+        sat out (the WFQ start-time fix) and monopolize the slots."""
+        sched = MultiTenantScheduler()
+        for i in range(8):
+            _mk(sched, i, tenant="busy")
+        for _ in range(6):
+            sched.next_request()
+        _mk(sched, 50, tenant="sleeper")
+        _mk(sched, 51, tenant="sleeper")
+        picks = [sched.next_request().tenant for _ in range(4)]
+        # sleeper gets its fair share of what remains, not all of it first
+        assert picks.count("sleeper") == 2 and picks.count("busy") == 2
+
+
+class TestPriorityAndDeadline:
+    def test_priority_class_is_strict(self):
+        sched = MultiTenantScheduler()
+        _mk(sched, 1, tenant="a", priority=0)
+        _mk(sched, 2, tenant="b", priority=5)
+        _mk(sched, 3, tenant="a", priority=5)
+        picked = [sched.next_request().id for _ in range(3)]
+        assert set(picked[:2]) == {2, 3} and picked[2] == 1
+        assert sched.peek_priority() is None
+
+    def test_deadline_orders_within_class(self):
+        sched = MultiTenantScheduler()
+        _mk(sched, 1, deadline_s=5.0)
+        _mk(sched, 2, deadline_s=1.0)
+        _mk(sched, 3)  # no deadline sorts last in the class
+        assert [sched.next_request().id for _ in range(3)] == [2, 1, 3]
+
+    def test_requeue_resumes_before_fresh_arrivals(self):
+        sched = MultiTenantScheduler()
+        first = _mk(sched, 1)
+        _mk(sched, 2)
+        got = sched.next_request()
+        assert got is first
+        sched.requeue(first)  # preempted
+        _mk(sched, 3)
+        assert sched.next_request() is first  # front of its class
+
+    def test_requeue_does_not_double_charge_vtime(self):
+        """A preempted request's WFQ cost is billed once: the tenant a
+        high-priority class preempts must not also lose fair share."""
+        sched = MultiTenantScheduler()
+        a = _mk(sched, 1)
+        _mk(sched, 2)
+        assert sched.next_request() is a
+        v0 = sched.tenant("default").vtime
+        assert v0 > 0
+        for _ in range(3):  # preempt/resume cycles
+            sched.requeue(a)
+            assert sched.next_request() is a  # front of its class
+        assert sched.tenant("default").vtime == v0
+        assert not sched._billed  # re-pop reclaims the marker
+
+
+class TestQuotas:
+    def test_over_quota_tenant_yields_under_contention(self):
+        cfg = SchedulerConfig(
+            tenants={"metered": TenantConfig(quota=4.0)}, quota_window_s=3600.0,
+        )
+        sched = MultiTenantScheduler(cfg, now_fn=lambda: 0.0)
+        for i in range(3):
+            _mk(sched, 10 + i, tenant="metered")
+            _mk(sched, 20 + i, tenant="free")
+        sched.note_tokens("metered", 10)  # burn past the 4-token window
+        picks = [sched.next_request().tenant for _ in range(3)]
+        assert picks == ["free", "free", "free"]
+
+    def test_work_conserving_when_alone(self):
+        cfg = SchedulerConfig(
+            tenants={"metered": TenantConfig(quota=1.0)}, quota_window_s=3600.0,
+        )
+        sched = MultiTenantScheduler(cfg, now_fn=lambda: 0.0)
+        _mk(sched, 1, tenant="metered")
+        sched.note_tokens("metered", 100)
+        # deep in quota debt, but idle capacity is never wasted
+        assert sched.next_request().id == 1
+
+    def test_quota_debt_floored_at_one_window(self):
+        """Work-conserving generation while alone must not starve the
+        tenant for unbounded time once contention returns: debt is
+        floored at -quota, so re-entry costs at most one window."""
+        cfg = SchedulerConfig(
+            tenants={"m": TenantConfig(quota=10.0)}, quota_window_s=1.0,
+        )
+        clock = [0.0]
+        sched = MultiTenantScheduler(cfg, now_fn=lambda: clock[0])
+        t = sched.tenant("m")
+        sched.note_tokens("m", 10_000)  # a minute of uncontended serving
+        assert t.bucket == -10.0
+        clock[0] = 2.0  # one window past the floor -> in quota again
+        sched._refill(t)
+        assert t.bucket == pytest.approx(10.0)
+
+    def test_bucket_refills_over_the_window(self):
+        clock = [0.0]
+        cfg = SchedulerConfig(
+            tenants={"m": TenantConfig(quota=10.0)}, quota_window_s=1.0,
+        )
+        sched = MultiTenantScheduler(cfg, now_fn=lambda: clock[0])
+        t = sched.tenant("m")
+        sched.note_tokens("m", 10)
+        assert t.bucket <= 0
+        clock[0] = 0.5  # half a window -> half the quota back
+        sched._refill(t)
+        assert t.bucket == pytest.approx(5.0)
+
+
+class TestAdmissionControl:
+    def test_global_queue_bound_sheds(self):
+        sched = MultiTenantScheduler(SchedulerConfig(max_queue_depth=2))
+        _mk(sched, 1)
+        _mk(sched, 2)
+        ok, reason = sched.admit(FakeReq(id=3))
+        assert not ok and reason == SHED_QUEUE_FULL
+        assert sched.rejected == 1 and sched.total_queued == 2
+
+    def test_per_tenant_bound_sheds(self):
+        cfg = SchedulerConfig(tenants={"t": TenantConfig(max_queued=1)})
+        sched = MultiTenantScheduler(cfg)
+        _mk(sched, 1, tenant="t")
+        ok, reason = sched.admit(FakeReq(id=2, tenant="t"))
+        assert not ok and reason == SHED_TENANT_QUEUE_FULL
+        ok, _ = sched.admit(FakeReq(id=3, tenant="other"))
+        assert ok  # the bound is per tenant, not global
+
+    def test_explicit_none_max_queued_exempts_from_global_bound(self):
+        """TenantConfig docstring contract: max_queued=None on an
+        EXPLICIT config means 'global bound only' — the way to exempt one
+        tenant; unconfigured tenants still get the global default."""
+        cfg = SchedulerConfig(
+            max_tenant_queue_depth=2, tenants={"vip": TenantConfig()},
+        )
+        sched = MultiTenantScheduler(cfg)
+        for i in range(4):
+            _mk(sched, i, tenant="vip")  # past the global default: all in
+        _mk(sched, 10, tenant="walkin")
+        _mk(sched, 11, tenant="walkin")
+        ok, reason = sched.admit(FakeReq(id=12, tenant="walkin"))
+        assert not ok and reason == SHED_TENANT_QUEUE_FULL
+
+    def test_rotating_tenant_ids_do_not_grow_state_unbounded(self):
+        """One tenant id per user must not leak scheduler state (and
+        per-tenant gauge cardinality) forever: idle unconfigured tenants
+        are reaped at the max_tenants bound; configured and queued
+        tenants survive."""
+        cfg = SchedulerConfig(
+            max_tenants=8, tenants={"pinned": TenantConfig(weight=2.0)},
+        )
+        sched = MultiTenantScheduler(cfg)
+        pin = _mk(sched, 10_000, tenant="pinned")
+        keep = _mk(sched, 10_001, tenant="queued-stays")
+        for i in range(100):
+            # priority 5: the pop always drains the rotating user, so its
+            # tenant goes idle while the two P0 requests stay queued
+            _mk(sched, i, tenant=f"user-{i}", priority=5)
+            assert sched.next_request().id == i
+        assert len(sched.tenants) <= 8
+        assert "pinned" in sched.tenants and "queued-stays" in sched.tenants
+        assert len(sched.metrics()) <= 3 + 3 * 8  # gauge family is bounded
+        assert {r.id for r in sched.queued()} == {pin.id, keep.id}
+
+    def test_remove_and_queued_snapshot(self):
+        sched = MultiTenantScheduler()
+        a, b = _mk(sched, 1), _mk(sched, 2)
+        assert {r.id for r in sched.queued()} == {1, 2}
+        assert sched.remove(a) and not sched.remove(a)
+        assert [r.id for r in sched.queued()] == [2]
+        assert sched.next_request() is b
+
+
+class TestPressurePicks:
+    def test_pick_shed_lowest_priority_newest(self):
+        sched = MultiTenantScheduler()
+        _mk(sched, 1, priority=0)
+        _mk(sched, 2, priority=5)
+        _mk(sched, 3, priority=0)  # same class, newer -> shed first
+        assert sched.pick_shed().id == 3
+        assert sched.pick_shed(max_priority=5).id == 3
+        # nothing strictly below 0
+        assert sched.pick_shed(max_priority=0) is None
+
+    def test_pick_victim_lowest_class_least_progress(self):
+        sched = MultiTenantScheduler()
+        live = [
+            (0, FakeReq(id=1, priority=0, tokens=[1, 2, 3])),
+            (1, FakeReq(id=2, priority=0, tokens=[1])),   # cheapest replay
+            (2, FakeReq(id=3, priority=4, tokens=[])),
+        ]
+        slot, req = sched.pick_victim(live, min_priority=4)
+        assert (slot, req.id) == (1, 2)
+        # equal classes never preempt each other (thrash guard)
+        assert sched.pick_victim(live, min_priority=0) is None
+
+    def test_preemption_disabled_by_config(self):
+        sched = MultiTenantScheduler(SchedulerConfig(preemption=False))
+        assert sched.pick_victim([(0, FakeReq(id=1, priority=0))], 9) is None
+
+    def test_peek_priority_uses_quota_filtered_pool(self):
+        """An over-quota tenant's waiting high class must not drive
+        preemption: next_request would refuse to schedule it (in-quota
+        work exists), so a preemption it triggered would be refilled by
+        an equal-priority request — preempt/re-admit churn."""
+        cfg = SchedulerConfig(
+            tenants={"metered": TenantConfig(quota=4.0)}, quota_window_s=3600.0,
+        )
+        sched = MultiTenantScheduler(cfg, now_fn=lambda: 0.0)
+        _mk(sched, 1, tenant="metered", priority=5)
+        _mk(sched, 2, tenant="free", priority=0)
+        sched.note_tokens("metered", 10)  # burn past the window
+        # the P5 request cannot be the next pop, so it must not be peeked
+        assert sched.peek_priority() == 0
+        assert sched.next_request().id == 2
+        # alone in the queue the over-quota tenant IS schedulable
+        # (work-conserving), and its class drives preemption again
+        assert sched.peek_priority() == 5
+        assert sched.next_request().id == 1
+
+
+class TestThreadSafety:
+    def test_concurrent_submit_never_crashes_the_pop_loop(self):
+        """serve() explicitly supports submit() from other threads: an
+        admit() appending to a tenant queue mid next_request() sort must
+        not raise ('list modified during sort') or lose requests."""
+        import threading
+
+        sched = MultiTenantScheduler(SchedulerConfig(
+            max_queue_depth=100000, max_tenant_queue_depth=None))
+        n_threads, per_thread = 4, 300
+        errors = []
+
+        def submitter(base):
+            try:
+                for i in range(per_thread):
+                    _mk(sched, base + i, tenant=f"t{(base + i) % 3}",
+                        priority=i % 3)
+            except Exception as e:  # pragma: no cover - the failure mode
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=submitter, args=(k * per_thread,))
+            for k in range(n_threads)
+        ]
+        for th in threads:
+            th.start()
+        popped = 0
+        try:
+            while any(th.is_alive() for th in threads) or sched.total_queued:
+                sched.peek_priority()
+                sched.pick_shed()
+                sched.metrics()
+                if sched.next_request() is not None:
+                    popped += 1
+        finally:
+            for th in threads:
+                th.join()
+        assert not errors, errors
+        assert popped == n_threads * per_thread
+        assert sched.admitted == popped
+
+
+class TestPrefillBudgetController:
+    def test_breach_backs_off_multiplicatively(self):
+        c = PrefillBudgetController(
+            50.0, budget=2.0, observe_every=1, min_samples=1
+        )
+        c.observe(80.0, samples=16)
+        assert c.budget == pytest.approx(1.4)  # 2.0 * 0.7
+        for _ in range(20):
+            c.observe(80.0, samples=16)
+        assert c.budget == pytest.approx(c.min_budget)
+        assert c.breaches == 21
+
+    def test_headroom_recovers_additively(self):
+        c = PrefillBudgetController(
+            50.0, budget=0.5, observe_every=1, min_samples=1
+        )
+        c.observe(10.0, samples=16)
+        assert c.budget == pytest.approx(0.6)
+        for _ in range(100):
+            c.observe(10.0, samples=16)
+        assert c.budget == pytest.approx(c.max_budget)
+
+    def test_hysteresis_band_holds(self):
+        c = PrefillBudgetController(
+            50.0, budget=1.0, observe_every=1, min_samples=1
+        )
+        c.observe(45.0, samples=16)  # between headroom*slo and slo
+        assert c.budget == 1.0 and c.adjustments == 0
+
+    def test_too_few_samples_is_a_no_op(self):
+        c = PrefillBudgetController(50.0, observe_every=1, min_samples=8)
+        c.observe(500.0, samples=3)
+        assert c.budget == 1.0 and c.breaches == 0
+
+    def test_observe_every_rate_limits(self):
+        c = PrefillBudgetController(
+            50.0, budget=2.0, observe_every=4, min_samples=1
+        )
+        for _ in range(3):
+            c.observe(80.0, samples=16)
+        assert c.budget == 2.0  # not yet
+        c.observe(80.0, samples=16)
+        assert c.budget == pytest.approx(1.4)
+
+
+class _FakeEngine:
+    """The slice of ServingEngine the injector touches."""
+
+    def __init__(self, allocator=None):
+        self.step_count = 0
+        if allocator is not None:
+            self._allocator = allocator
+
+
+class TestFaultInjector:
+    def test_same_seed_same_schedule(self):
+        logs = []
+        for _ in range(2):
+            sleeps = []
+            fi = FaultInjector(seed=7, sleep_fn=sleeps.append)
+            fi.delay_decode(prob=0.5, delay_s=0.003)
+            eng = _FakeEngine()
+            for step in range(32):
+                eng.step_count = step
+                fi.before_decode(eng)
+            logs.append(list(fi.log))
+        assert logs[0] == logs[1] and len(logs[0]) > 0
+
+    def test_every_n_delay_fires_on_schedule(self):
+        sleeps = []
+        fi = FaultInjector(sleep_fn=sleeps.append).delay_prefill(
+            every=4, delay_s=0.01, start=4
+        )
+        eng = _FakeEngine()
+        for step in range(12):
+            eng.step_count = step
+            fi.before_prefill(eng)
+        assert [s for s, _, _ in fi.log] == [4, 8]
+        assert sleeps == [0.01, 0.01]
+
+    def test_page_squeeze_holds_and_releases(self):
+        from accelerate_tpu.serving.pages import PageAllocator
+
+        alloc = PageAllocator(10)
+        fi = FaultInjector().squeeze_pages(at_step=2, pages=4, hold_steps=3)
+        eng = _FakeEngine(alloc)
+        eng.step_count = 1
+        fi.on_step(eng)
+        assert alloc.in_use == 0
+        eng.step_count = 2
+        fi.on_step(eng)
+        assert alloc.in_use == 4
+        eng.step_count = 5
+        fi.on_step(eng)
+        assert alloc.in_use == 0
+        kinds = [k for _, k, _ in fi.log]
+        assert kinds == ["squeeze_pages", "release_pages"]
+
+    def test_page_squeeze_releases_even_when_step_count_freezes(self):
+        """engine.step_count only advances when a dispatch runs — a
+        squeeze that starves every slot would freeze it. The invocation
+        bound releases the pages anyway, so the engine can recover."""
+        from accelerate_tpu.serving.pages import PageAllocator
+
+        alloc = PageAllocator(10)
+        fi = FaultInjector().squeeze_pages(at_step=2, pages=10, hold_steps=3)
+        eng = _FakeEngine(alloc)
+        eng.step_count = 2
+        fi.on_step(eng)
+        # everything allocatable held (1 page is reserved): the engine wedges
+        assert alloc.in_use == 9
+        for _ in range(4 * 3 + 16):  # step_count never advances
+            fi.on_step(eng)
+        assert alloc.in_use == 0
+        assert [k for _, k, _ in fi.log] == ["squeeze_pages", "release_pages"]
+
+    def test_storm_fires_once(self):
+        fired = []
+        fi = FaultInjector().storm(at_step=3, fire=fired.append)
+        eng = _FakeEngine()
+        for step in range(6):
+            eng.step_count = step
+            fi.on_step(eng)
+        assert fired == [eng]
