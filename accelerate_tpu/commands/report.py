@@ -112,20 +112,45 @@ def load_costs(target: str) -> dict:
             else:
                 cur["wall_s"] = round(cur.get("wall_s", 0.0) + (row.get("wall_s") or 0.0), 4)
                 cur["calls"] = cur.get("calls", 0) + (row.get("calls") or 0)
+                # dynamic rows (per-call cost varies with runtime state —
+                # the paged decode kernel) merge by TOTALS, not by the
+                # first host's per-call average
+                for key in ("flops_total", "hbm_bytes_total"):
+                    if row.get(key) is not None or cur.get(key) is not None:
+                        cur[key] = (cur.get(key) or 0.0) + (row.get(key) or 0.0)
                 for k, v in row.items():
                     cur.setdefault(k, v)
     rows = sorted(merged.values(), key=lambda r: -(r.get("wall_s") or 0.0))
     # re-derive the utilization numbers over the merged wall
     pf, pb = peaks.get("peak_flops"), peaks.get("peak_hbm_bw")
     for row in rows:
+        if row.get("dynamic") and row.get("calls"):
+            for total, per_call in (("flops_total", "flops_per_call"),
+                                    ("hbm_bytes_total", "hbm_bytes_per_call")):
+                if row.get(total) is not None:
+                    row[per_call] = row[total] / row["calls"]
+            # AI / roofline class must come from the merged totals too, or
+            # the row would pair fleet-total throughput numbers with host
+            # 0's classification
+            if row.get("flops_total") and row.get("hbm_bytes_total"):
+                ai = row["flops_total"] / row["hbm_bytes_total"]
+                row["arith_intensity"] = round(ai, 4)
+                ridge = row.get("ridge_intensity") or peaks.get("ridge_intensity")
+                if ridge:
+                    row["roofline"] = (
+                        "compute-bound" if ai >= ridge else "memory-bound"
+                    )
         wall, calls = row.get("wall_s") or 0.0, row.get("calls") or 0
         if wall > 0 and calls > 0:
             if row.get("flops_per_call") and pf:
                 row["mfu_model_pct"] = round(
                     100.0 * row["flops_per_call"] * calls / wall / pf, 3)
-            if row.get("hbm_bytes_per_call") and pb:
-                row["bw_util_pct"] = round(
-                    100.0 * row["hbm_bytes_per_call"] * calls / wall / pb, 3)
+            if row.get("hbm_bytes_per_call"):
+                row["hbm_gbps"] = round(
+                    row["hbm_bytes_per_call"] * calls / wall / 1e9, 3)
+                if pb:
+                    row["bw_util_pct"] = round(
+                        100.0 * row["hbm_bytes_per_call"] * calls / wall / pb, 3)
     return {**peaks, "executables": rows}
 
 
@@ -218,11 +243,12 @@ def format_report(data: dict) -> str:
         lines.append("top executables by measured wall (roofline vs "
                      f"ridge {ridge_txt} flops/byte):")
         header = ("executable", "wall_s", "calls", "class", "AI",
-                  "MFU(model)", "BW util")
+                  "MFU(model)", "BW util", "GB/s")
         table = [header]
         for row in rows[:10]:
             mfu = row.get("mfu_model_pct")
             bw = row.get("bw_util_pct")
+            gbps = row.get("hbm_gbps")
             table.append((
                 str(row.get("name")),
                 f"{row.get('wall_s', 0.0):.3f}" if row.get("wall_s") is not None else "",
@@ -231,6 +257,7 @@ def format_report(data: dict) -> str:
                 f"{row['arith_intensity']:.2f}" if row.get("arith_intensity") is not None else "",
                 f"{mfu:.2f}%" if mfu is not None else "",
                 f"{bw:.2f}%" if bw is not None else "",
+                f"{gbps:.1f}" if gbps is not None else "",
             ))
         widths = [max(len(r[i]) for r in table) for i in range(len(header))]
         for r in table:

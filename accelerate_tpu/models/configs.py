@@ -76,6 +76,17 @@ class DecoderConfig:
     # prefill runs against dense per-slot gather views the engine builds.
     kv_page_size: Optional[int] = None   # tokens per page, power of two
     kv_num_pages: Optional[int] = None   # physical pages in the arena
+    # decode-attention implementation for the KV-cache decode paths
+    # (ops/attention dispatch). None -> the ATT_DECODE_KERNEL env knob
+    # (default "paged": the length-aware pallas decode kernel on TPU —
+    # HBM read ∝ live tokens — with a warn-once masked-dense fallback
+    # elsewhere); "dense" forces the masked-dense reference path;
+    # "interpret" runs the same kernel through the pallas interpreter
+    # (the CPU test/CI mode). ``decode_kernel_block`` tunes the
+    # dense-arena kernel's kv block size (must divide the cache length;
+    # the paged arena always walks in kv_page_size blocks).
+    decode_kernel: Optional[str] = None
+    decode_kernel_block: Optional[int] = None
     # fp8 recipe (ops/fp8.py): every Linear-equivalent contraction (QKV/O + MLP) runs e4m3-fwd/e5m2-bwd.
     # Flipped on by Accelerator(mixed_precision="fp8"). ``fp8_recipe``:
     # "current" (per-tensor amax each step, XLA fuses the reduction) or
@@ -149,6 +160,16 @@ class DecoderConfig:
                 raise ValueError(f"kv_page_size must be a power of two, got {ps}")
             if self.kv_num_pages < 1:
                 raise ValueError(f"kv_num_pages must be >= 1, got {self.kv_num_pages}")
+        if self.decode_kernel not in (None, "paged", "dense", "interpret"):
+            raise ValueError(
+                "decode_kernel must be None, 'paged', 'dense' or "
+                f"'interpret', got {self.decode_kernel!r}"
+            )
+        if self.decode_kernel_block is not None and self.decode_kernel_block < 1:
+            raise ValueError(
+                f"decode_kernel_block must be a positive block size, got "
+                f"{self.decode_kernel_block}"
+            )
         if self.moe_num_experts == 1:
             raise ValueError("moe_num_experts must be 0 (dense) or >= 2")
         if self.moe_num_experts > 1 and not (1 <= self.moe_top_k <= self.moe_num_experts):

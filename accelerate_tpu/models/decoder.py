@@ -164,10 +164,13 @@ class DecoderAttention(nn.Module):
     ``kv_num_pages`` set) switches the cache storage to physical pages
     (``serving/pages.py``): leaves are [num_pages, KVH, page_size, D], the
     scatter routes each position through its slot's table entry, and the
-    read gathers pages back into position order
-    (``ops/attention.paged_decode_attention``). Sharing one physical page
-    across slots' tables is copy-on-write prefix sharing; the serving
-    engine forks pages before divergent writes.
+    read (``ops/attention.paged_decode_attention``) walks only the slot's
+    LIVE pages via the pallas decode kernel on TPU — HBM traffic per step
+    is live tokens, not the arena reservation — falling back to the
+    gather + masked-dense reference elsewhere (``config.decode_kernel`` /
+    ``ATT_DECODE_KERNEL``). Sharing one physical page across slots'
+    tables is copy-on-write prefix sharing; the serving engine forks
+    pages before divergent writes.
 
     ``causal=False`` (+ optional ``kv_mask``) is the bidirectional form the
     seq2seq encoder reuses (models/seq2seq.py) — same projections, RoPE and
@@ -259,6 +262,12 @@ class DecoderAttention(nn.Module):
                 rows = jnp.arange(b)
                 kv_new = jnp.swapaxes(k, 1, 2)  # [B, S, KVH, D]
                 vv_new = jnp.swapaxes(v, 1, 2)
+                # decode-kernel knobs (ops/attention dispatch): the pallas
+                # length-aware kernel on TPU / under "interpret", the
+                # masked-dense reference otherwise. getattr: Seq2SeqConfig
+                # reuses this module without the decode_kernel fields.
+                dk_impl = getattr(cfg, "decode_kernel", None)
+                dk_blk = getattr(cfg, "decode_kernel_block", None)
                 if paged:
                     from ..ops.attention import paged_decode_attention
 
@@ -272,6 +281,7 @@ class DecoderAttention(nn.Module):
                     out = paged_decode_attention(
                         q, k_pages, v_pages,
                         page_table=page_table, q_positions=pos2d,
+                        impl=dk_impl,
                     )
                 else:
                     from ..ops.attention import decode_attention
@@ -280,7 +290,10 @@ class DecoderAttention(nn.Module):
                     v_full = cached_v.value.at[rows[:, None], :, pos2d].set(vv_new)
                     cached_k.value = k_full
                     cached_v.value = v_full
-                    out = decode_attention(q, k_full, v_full, q_positions=pos2d)
+                    out = decode_attention(
+                        q, k_full, v_full, q_positions=pos2d,
+                        impl=dk_impl, block_kv=dk_blk,
+                    )
             else:
                 k_full = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
                 v_full = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
@@ -289,8 +302,20 @@ class DecoderAttention(nn.Module):
                 cache_index.value = cur + s
                 from ..ops.attention import decode_attention
 
-                # query i sits at global position cur+i; valid kv = [0, cur+i]
-                out = decode_attention(q, k_full, v_full, q_positions=cur + jnp.arange(s))
+                # query i sits at global position cur+i; valid kv = [0, cur+i].
+                # s == 1 is the single-stream decode loop — same kernel
+                # dispatch as the slot-arena path, so generation.generate
+                # reads live tokens, not the whole right-sized arena, per
+                # step. s > 1 on this branch is ALWAYS a prefill chunk
+                # (serving's bucketed admission against a slot view):
+                # force the masked-dense reference there regardless of the
+                # bucket size, so chunked prefill stays bit-identical to
+                # the full-prefill path token-exactness is proven against.
+                out = decode_attention(
+                    q, k_full, v_full, q_positions=cur + jnp.arange(s),
+                    impl=getattr(cfg, "decode_kernel", None) if s == 1 else "dense",
+                    block_kv=getattr(cfg, "decode_kernel_block", None),
+                )
         elif (
             self.causal
             and kv_mask is None

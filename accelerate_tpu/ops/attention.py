@@ -22,12 +22,20 @@ Capabilities:
 - custom VJP: pallas forward AND backward (dq and dk/dv kernels)
 - `(out, lse)` residual export for the ring-attention inner step
 - `interpret=True` runs the same kernels on CPU for tests
+- ragged/paged DECODE kernels (`decode_attention` / `paged_decode_attention`
+  dispatch): length-aware online-softmax walk over only each slot's live kv
+  blocks — straight from the physical page arena through the slot's page
+  table, or in fixed blocks over a dense arena — so decode HBM traffic
+  scales with live tokens, not arena capacity. Masked-dense stays the
+  fallback + bit-exactness reference (`ATT_DECODE_KERNEL=paged|dense`,
+  "interpret" for CPU tests)
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -347,12 +355,21 @@ def _pick_block(s: int, preferred: int) -> int:
     return 0  # no valid block → caller falls back to XLA
 
 
-def _grid_params(interpret: bool):
+def _compiler_params(dimension_semantics):
+    """Mosaic compiler params across jax versions: 0.4.x spells the class
+    ``TPUCompilerParams``; newer builds renamed it ``CompilerParams``."""
+    mod = _pltpu_lazy._resolve()
+    cls = getattr(mod, "CompilerParams", None) or getattr(mod, "TPUCompilerParams")
+    return cls(dimension_semantics=dimension_semantics)
+
+
+def _grid_params(
+    interpret: bool,
+    semantics=("parallel", "parallel", "parallel", "arbitrary"),
+):
     kw = {"interpret": interpret}
     if not interpret and _has_pltpu():
-        kw["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
-        )
+        kw["compiler_params"] = _compiler_params(semantics)
     return kw
 
 
@@ -620,6 +637,306 @@ def flash_attention_bwd(
     )
 
 
+# ---------------------------------------------------------------------------
+# pallas ragged/paged decode-attention kernel (ROADMAP item 2)
+#
+# The masked-dense decode read streams the WHOLE arena reservation through
+# HBM every step — decode bandwidth scales with capacity, not live tokens.
+# These kernels walk only each slot's live KV blocks: a (slots × kv-heads ×
+# kv-blocks) grid with flash-style online softmax, where blocks past a
+# slot's frontier are clamped to the last live block in the BlockSpec index
+# map (the pipeline elides the re-fetch of an unchanged block, so dead
+# blocks cost neither DMA nor compute) and skipped by ``pl.when``. The
+# paged variant reads K/V straight from the physical page arena
+# ([num_pages, KVH, page_size, D]) through each slot's device page table
+# (scalar-prefetched so the table drives the index maps); the dense variant
+# walks a [B, KVH, L, D] arena in fixed blocks — the same win for the
+# single-stream decode loop and the flat slot arena. GQA folds the query
+# head group (× the Sq query rows: the multi-query form spec_verify and
+# fused bursts use) into one [group*Sq, D] block per kv head, so K/V are
+# never expanded.
+# ---------------------------------------------------------------------------
+
+_DECODE_KERNEL_MODES = ("paged", "dense", "interpret")
+# multi-query width the kernel accepts: decode (1), fused bursts (1/step),
+# speculative verify (K+1). Prefill-size chunks (64+) stay on the dense
+# path by design — they are compute-shaped, and the row-position unroll
+# below is linear in Sq.
+_DECODE_KERNEL_MAX_SQ = 16
+_decode_fallback_warned: set = set()
+
+
+def resolve_decode_kernel(impl: Optional[str] = None) -> str:
+    """Resolve the decode-attention implementation choice: the explicit
+    ``impl`` (``DecoderConfig.decode_kernel``) wins, else the
+    ``ATT_DECODE_KERNEL`` env knob, else ``"paged"`` (the kernel, with a
+    warn-once dense fallback off-TPU). ``"interpret"`` runs the same kernel
+    through the pallas interpreter — the CPU test/CI mode."""
+    mode = impl or os.environ.get("ATT_DECODE_KERNEL", "paged")
+    if mode not in _DECODE_KERNEL_MODES:
+        raise ValueError(
+            f"ATT_DECODE_KERNEL/decode_kernel must be one of "
+            f"{_DECODE_KERNEL_MODES}, got {mode!r}"
+        )
+    return mode
+
+
+def _warn_once(key: str, message: str, *args):
+    if key in _decode_fallback_warned:
+        return
+    _decode_fallback_warned.add(key)
+    import logging
+
+    logging.getLogger(__name__).warning(message, *args)
+
+
+def _warn_decode_fallback(reason: str):
+    """Warn-once per distinct reason (mirrors the fp8-without-MXU warn):
+    the paged decode kernel was requested (or defaulted) but this process
+    silently runs the masked-dense path instead, so decode bandwidth
+    scales with arena capacity, not live tokens."""
+    _warn_once(
+        reason,
+        "paged decode-attention kernel unavailable (%s); falling back to "
+        "the masked-dense read — decode HBM traffic will scale with the "
+        "arena reservation, not live tokens. Set ATT_DECODE_KERNEL=dense "
+        "(or DecoderConfig.decode_kernel='dense') to silence, or "
+        "'interpret' to run the kernel through the pallas interpreter.",
+        reason,
+    )
+
+
+def _decode_kernel_gate(mode: str, sq: int, d: int, blk: int):
+    """(use_kernel, interpret) for one dispatch. Falls back silently for
+    by-design exclusions (``dense`` mode, prefill-size Sq) and with a
+    warn-once for environment/shape gates."""
+    if mode == "dense":
+        return False, False
+    if sq > _DECODE_KERNEL_MAX_SQ:
+        return False, False
+    if blk <= 0:
+        _warn_decode_fallback("no valid kv block size for this cache length")
+        return False, False
+    if not _has_pltpu():
+        _warn_decode_fallback("pallas TPU support missing from this jaxlib")
+        return False, False
+    if mode == "interpret":
+        return True, True
+    if jax.default_backend() != "tpu":
+        _warn_decode_fallback(f"no TPU backend ({jax.default_backend()} process)")
+        return False, False
+    if d % 128 != 0 or blk % 8 != 0:
+        _warn_decode_fallback(
+            f"shape gate: head_dim {d} must be a 128-multiple and the kv "
+            f"block/page size {blk} an 8-multiple for the compiled kernel"
+        )
+        return False, False
+    return True, False
+
+
+def decode_kernel_active(config, sq: int = 1) -> bool:
+    """Would a paged decode dispatch of query width ``sq`` (1 = the plain
+    decode step; spec_draft_len+1 = the verify program) on a model with
+    this config run the pallas kernel in this process? The serving engine
+    and bench use this to decide whether a dispatch bills the
+    ``paged_decode_kernel`` roofline row — it must mirror
+    :func:`paged_decode_attention`'s gate exactly, or the row would claim
+    bandwidth a fallback path never achieved."""
+    page_size = getattr(config, "kv_page_size", None)
+    if not page_size:
+        return False
+    mode = resolve_decode_kernel(getattr(config, "decode_kernel", None))
+    if mode == "dense":
+        return False
+    head_dim = int(getattr(config, "head_dim", 0) or 0)
+    use, _ = _decode_kernel_gate(mode, sq, head_dim, int(page_size))
+    return use
+
+
+def _pick_decode_block(length: int, preferred: Optional[int], interpret: bool) -> int:
+    """kv block for the dense-arena decode kernel: the largest candidate
+    dividing the cache length. Smaller blocks exit earlier on short live
+    lengths; bigger blocks amortize grid overhead — 256 measured best on
+    2k-8k arenas (the same trade as ``_pick_block``, at decode's smaller
+    working set). Interpret mode (CPU tests) admits tiny blocks the TPU
+    tiling rules would reject."""
+    cands = ([int(preferred)] if preferred else []) + [512, 256, 128, 64, 32, 16]
+    if interpret:
+        cands += [8, 4, 2, 1]
+    for cand in cands:
+        if 0 < cand <= length and length % cand == 0:
+            return cand
+    return 0
+
+
+def _decode_kernel_body(maxblk_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                        acc, m_scr, l_scr, *, sm_scale, bk, sq, group):
+    """Online-softmax accumulation over one slot's kv blocks — shared by
+    the paged and dense-arena variants (only the BlockSpec index maps
+    differ). Grid is (B, KVH, n_blocks) with the block dim innermost
+    ("arbitrary"); blocks past ``maxblk_ref[b]`` (the slot's last live
+    block) are skipped — their operand fetch was already elided by the
+    clamped index map. Per-element validity is ``kv position <= the query
+    row's position``, the exact mask of the dense reference, so parked /
+    stale / rolled-back entries inside a live block contribute exactly
+    zero probability."""
+    b, ib = pl.program_id(0), pl.program_id(2)
+    nb = pl.num_programs(2)
+    g = group * sq
+
+    @pl.when(ib == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    @pl.when(ib <= maxblk_ref[b])
+    def _body():
+        q = q_ref[0, 0]  # [G, D] — the kv head's query group × Sq rows
+        k = k_ref[0, 0]  # [bk, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        kvpos = ib * bk + jax.lax.broadcasted_iota(jnp.int32, (g, bk), 1)
+        if sq == 1:
+            rowpos = jnp.full((g, bk), pos_ref[b, 0], jnp.int32)
+        else:
+            # row r of the [group, Sq] fold is query token t = r % sq;
+            # sq is compile-time small (<= _DECODE_KERNEL_MAX_SQ), so the
+            # scalar reads unroll
+            t_idx = jax.lax.broadcasted_iota(jnp.int32, (g, bk), 0) % sq
+            rowpos = jnp.zeros((g, bk), jnp.int32)
+            for t in range(sq):
+                rowpos = jnp.where(t_idx == t, pos_ref[b, t], rowpos)
+        s = jnp.where(kvpos <= rowpos, s, NEG_INF)
+        m_prev = m_scr[...][:, :1]
+        l_prev = l_scr[...][:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)
+        l_scr[...] = jnp.broadcast_to(
+            l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True), l_scr.shape
+        )
+        acc[...] = acc[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+
+    @pl.when(ib == nb - 1)
+    def _out():
+        l = l_scr[...][:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[...] / safe_l).astype(o_ref.dtype)
+
+
+def _paged_kernel_entry(maxblk_ref, pos_ref, table_ref, q_ref, k_ref, v_ref,
+                        o_ref, acc, m_scr, l_scr, **kw):
+    # the page table is consumed by the index maps only
+    _decode_kernel_body(maxblk_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                        acc, m_scr, l_scr, **kw)
+
+
+def _decode_grid_params(interpret: bool):
+    # the decode grid is 3-dim (slots, kv-heads, kv-blocks); only the
+    # block walk is sequential
+    return _grid_params(interpret, ("parallel", "parallel", "arbitrary"))
+
+
+def _fold_q_heads(q, kvh):
+    """[B, H, Sq, D] -> [B, KVH, group*Sq, D]: query heads of one kv head's
+    group (plus their Sq rows) become one MXU-friendly block. Pure reshape
+    — H is laid out [kv0's group, kv1's group, ...] (the ``h // group``
+    BlockSpec convention of the flash kernels)."""
+    b, h, sq, d = q.shape
+    return q.reshape(b, kvh, (h // kvh) * sq, d)
+
+
+def _positions_2d(q_positions, b):
+    pos = jnp.asarray(q_positions, jnp.int32)
+    if pos.ndim == 1:  # [Sq] shared across the batch
+        pos = jnp.broadcast_to(pos[None, :], (b, pos.shape[0]))
+    return pos
+
+
+def _paged_decode_kernel_call(q, k_pages, v_pages, page_table, pos,
+                              sm_scale, interpret):
+    b, h, sq, d = q.shape
+    _, kvh, ps, _ = k_pages.shape
+    group = h // kvh
+    g = group * sq
+    n_blocks = page_table.shape[1]
+    q_r = _fold_q_heads(q, kvh)
+    # last live BLOCK per slot: index maps clamp here so dead grid steps
+    # re-address the same page (fetch elided), pl.when skips their compute
+    maxblk = (jnp.max(pos, axis=1) // ps).astype(jnp.int32)
+    kernel = functools.partial(
+        _paged_kernel_entry, sm_scale=sm_scale, bk=ps, sq=sq, group=group
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, kvh, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda b_, h_, ib, mb, po, tb: (b_, h_, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, ps, d),
+                lambda b_, h_, ib, mb, po, tb: (tb[b_, jnp.minimum(ib, mb[b_])], h_, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, ps, d),
+                lambda b_, h_, ib, mb, po, tb: (tb[b_, jnp.minimum(ib, mb[b_])], h_, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d), lambda b_, h_, ib, mb, po, tb: (b_, h_, 0, 0)),
+        scratch_shapes=[_vmem((g, d)), _vmem((g, 128)), _vmem((g, 128))],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        **_decode_grid_params(interpret),
+    )(maxblk, pos, page_table.astype(jnp.int32), q_r, k_pages, v_pages)
+    return out.reshape(b, h, sq, d)
+
+
+def _dense_decode_kernel_call(q, k, v, pos, sm_scale, bk, interpret):
+    b, h, sq, d = q.shape
+    kvh, length = k.shape[1], k.shape[2]
+    group = h // kvh
+    g = group * sq
+    q_r = _fold_q_heads(q, kvh)
+    maxblk = (jnp.max(pos, axis=1) // bk).astype(jnp.int32)
+    kernel = functools.partial(
+        _decode_kernel_body, sm_scale=sm_scale, bk=bk, sq=sq, group=group
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kvh, length // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda b_, h_, ib, mb, po: (b_, h_, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, bk, d),
+                lambda b_, h_, ib, mb, po: (b_, h_, jnp.minimum(ib, mb[b_]), 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, bk, d),
+                lambda b_, h_, ib, mb, po: (b_, h_, jnp.minimum(ib, mb[b_]), 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d), lambda b_, h_, ib, mb, po: (b_, h_, 0, 0)),
+        scratch_shapes=[_vmem((g, d)), _vmem((g, 128)), _vmem((g, 128))],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        **_decode_grid_params(interpret),
+    )(maxblk, pos, q_r, k, v)
+    return out.reshape(b, h, sq, d)
+
+
 def decode_attention(
     q: jax.Array,
     k: jax.Array,
@@ -627,6 +944,8 @@ def decode_attention(
     *,
     q_positions: jax.Array,
     sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+    block_kv: Optional[int] = None,
 ) -> jax.Array:
     """Masked KV-cache decode attention with per-row validity.
 
@@ -641,12 +960,32 @@ def decode_attention(
     previous occupant, padding from a bucketed prefill chunk) contribute
     exactly zero probability.
 
-    Deliberately plain XLA: at Sq ∈ {1, chunk} the score matrix is tiny and
-    the cost is the HBM read of K/V (~1 flop/byte) — a pallas kernel cannot
-    beat the fused gather here, and routing every decode flavor through ONE
-    code path is what makes batched decode token-exact vs. the sequential
-    ``generate()`` loop.
+    Dispatch: at decode widths (Sq <= 16) the length-aware pallas kernel
+    reads only the live kv blocks (HBM traffic ∝ live tokens, not L) on
+    TPU — or through the interpreter under ``impl='interpret'`` — per
+    :func:`resolve_decode_kernel` (``impl`` / ``ATT_DECODE_KERNEL``,
+    default "paged" with a warn-once dense fallback off-TPU). Prefill-size
+    chunks and the ``dense`` mode run the masked-dense XLA path, which
+    stays the bit-exactness reference. ``block_kv`` tunes the kernel's kv
+    block (must divide L; default: largest of 512..16 that does).
     """
+    mode = resolve_decode_kernel(impl)
+    sq, d = q.shape[2], q.shape[3]
+    if mode != "dense":
+        bk = _pick_decode_block(k.shape[2], block_kv, mode == "interpret")
+        if block_kv and bk and bk != int(block_kv):
+            _warn_once(
+                f"block_kv {block_kv}/{k.shape[2]}",
+                "decode_kernel_block %s does not divide the cache length "
+                "%s; the dense-arena decode kernel is using block %s "
+                "instead — pick a divisor to make the knob effective.",
+                block_kv, k.shape[2], bk,
+            )
+        use, interpret = _decode_kernel_gate(mode, sq, d, bk)
+        if use:
+            scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+            pos = _positions_2d(q_positions, q.shape[0])
+            return _dense_decode_kernel_call(q, k, v, pos, scale, bk, interpret)
     kv_pos = jnp.arange(k.shape[2])
     if q_positions.ndim == 1:  # [Sq] shared positions
         bias = jnp.where(kv_pos[None, :] <= q_positions[:, None], 0.0, NEG_INF)
@@ -683,22 +1022,39 @@ def paged_decode_attention(
     page_table: jax.Array,
     q_positions: jax.Array,
     sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Decode attention reading K/V through a per-slot page table.
 
     q: [B, H, Sq, D]; k_pages/v_pages: [num_pages, KVH, page_size, D];
     ``page_table`` [B, P] int32; ``q_positions`` [B, Sq] global positions.
-    The gather maps each slot's pages back into position order, after which
-    the read is exactly :func:`decode_attention`'s masked-dense path — the
-    CPU-sim fallback and the bit-exactness reference for any future pallas
-    paged kernel (ROADMAP item 2: a length-aware kernel walking only live
-    pages would cut the HBM read from arena capacity to live tokens; the
-    gather form keeps ONE semantic code path until that lands, which is what
-    makes paged decode provably token-exact vs. the dense arena).
+
+    On TPU (or under ``impl='interpret'``) the pallas paged kernel walks
+    each slot's live pages DIRECTLY from the physical arena — the HBM read
+    per step is the slot's live tokens (page-rounded), not its whole
+    ``P * page_size`` reservation, which is the decode-bandwidth lever at
+    high occupancy with mixed lengths. Otherwise (``impl='dense'`` /
+    ``ATT_DECODE_KERNEL=dense`` / pallas TPU absent — warn-once) the
+    gather maps each slot's pages back into position order and the read is
+    exactly :func:`decode_attention`'s masked-dense path: the CPU-sim
+    fallback and the bit-exactness reference the kernel is asserted
+    against (tests/test_decode_kernel.py).
     """
+    mode = resolve_decode_kernel(impl)
+    if mode != "dense":
+        sq, d = q.shape[2], q.shape[3]
+        use, interpret = _decode_kernel_gate(mode, sq, d, k_pages.shape[2])
+        if use:
+            scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+            pos = _positions_2d(q_positions, q.shape[0])
+            return _paged_decode_kernel_call(
+                q, k_pages, v_pages, page_table, pos, scale, interpret
+            )
     k_full = gather_kv_pages(k_pages, page_table)
     v_full = gather_kv_pages(v_pages, page_table)
-    return decode_attention(q, k_full, v_full, q_positions=q_positions, sm_scale=sm_scale)
+    return decode_attention(
+        q, k_full, v_full, q_positions=q_positions, sm_scale=sm_scale, impl="dense"
+    )
 
 
 def dot_product_attention(

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..generation import _sample, _sized_definition, depipeline
+from ..ops.attention import decode_kernel_active
 from .arena import arena_nbytes, init_arena, slot_view, write_slot
 from .pages import (
     NGramDrafter,
@@ -275,6 +276,33 @@ class ServingEngine:
                 self._paged_def, params, self.num_slots, self.pages_per_slot,
                 self._placer,
             )
+            # paged decode-kernel cost model (CostRegistry dynamic row):
+            # the kernel's HBM read per step is the live page set, which
+            # XLA's static cost_analysis (operand sizes = the whole arena)
+            # cannot see — so the engine bills modeled live-page bytes and
+            # flops per dispatch from its host-side lengths instead.
+            from .pages import _is_kv
+
+            kv_leaves = [
+                l for l in jax.tree_util.tree_leaves(self._arena) if _is_kv(l)
+            ]
+            self._kv_token_bytes = sum(
+                int(l.size) * l.dtype.itemsize // (self.num_pages * self.page_size)
+                for l in kv_leaves
+            )
+            pcfg = self._paged_def.config
+            # qk + pv matmuls per attended token per query row, all layers
+            self._kernel_flops_per_token = (
+                4 * pcfg.num_heads * pcfg.head_dim * pcfg.num_layers
+            )
+            self._kernel_costed = decode_kernel_active(pcfg)
+            # the verify program dispatches at query width K+1, which may
+            # fail the kernel's Sq gate even when the plain decode step
+            # rides the kernel — a dense-fallback verify must not bill the
+            # kernel's roofline row
+            self._kernel_costed_verify = bool(self.spec_k) and decode_kernel_active(
+                pcfg, sq=self.spec_k + 1
+            )
             self._page_tables = jnp.zeros(
                 (self.num_slots, self.pages_per_slot), jnp.int32
             )
@@ -294,6 +322,8 @@ class ServingEngine:
             self._prefix = None
             self._drafter = None
             self._verify_step = None
+            self._kernel_costed = False
+            self._kernel_costed_verify = False
             self._arena = init_arena(definition, params, self.num_slots, self._placer)
         self.page_forks = 0
         self.spec_proposed = 0
@@ -610,6 +640,11 @@ class ServingEngine:
             )
             self._page_tables = self._set_entry(self._page_tables, 0, 0, 0)
             self._arena = self._fork(self._arena, 0, 0)
+            if self._kernel_costed and costs is not None:
+                # seed the kernel's dynamic roofline row at warmup so a
+                # rollup/report taken before traffic already lists the
+                # executable (wall/bytes accumulate per decode dispatch)
+                costs.note_dynamic("paged_decode_kernel", 0.0, calls=0)
         self._tokens, self._lengths, self._rngs = self._admit_state(
             self._tokens, self._lengths, self._rngs, 0, 0, 0, rng
         )
@@ -1486,6 +1521,26 @@ class ServingEngine:
         decode step writes the PREVIOUS token before sampling the next)."""
         return req.prompt.size + len(req.tokens) - 1
 
+    def _kernel_step_cost(self, steps: int, width: int, extra: int = 0) -> dict:
+        """Modeled cost of the paged decode kernel for ``steps`` fused
+        dispatches of query width ``width`` over the current live slots
+        (``extra`` = additional positions written past the frontier this
+        round: k-1 for a burst, K for a verify). Token count is page-
+        rounded per slot — exactly the pages the kernel walks — so the
+        roofline row's achieved bytes/s tracks LIVE tokens, while the
+        static ``decode_step`` row keeps billing the arena-shaped program
+        (the gap between the two is the kernel's win, made attributable)."""
+        ps = self.page_size
+        toks = 0
+        for req in self._slot_req.values():
+            pos = self._next_write_pos(req) + extra
+            toks += (pos // ps + 1) * ps
+        return {
+            "flops": float(self._kernel_flops_per_token * toks * steps * width),
+            "hbm_bytes": float(self._kv_token_bytes * toks * steps),
+            "calls": steps,
+        }
+
     def _spec_verify_once(self) -> bool:
         """One speculative round: host drafter proposes K tokens per slot,
         one batched verify dispatch checks them all, the longest accepted
@@ -1513,6 +1568,10 @@ class ServingEngine:
                 continue
         if not self._slot_req:
             return True  # every live slot was shed under page pressure
+        kernel_cost = (
+            self._kernel_step_cost(1, k + 1, extra=k)
+            if self._kernel_costed_verify else None
+        )
         drafts_dev = jnp.asarray(drafts)
         self._note_forensics(
             "spec_verify",
@@ -1553,6 +1612,8 @@ class ServingEngine:
             costs = getattr(self.telemetry, "costs", None)
             if costs is not None:
                 costs.note_wall("spec_verify", wall)
+                if kernel_cost is not None:
+                    costs.note_dynamic("paged_decode_kernel", wall, **kernel_cost)
         return True
 
     def _grow_or_resolve(self, req: Request, slot: int, lo: int, hi: int) -> bool:
@@ -1592,6 +1653,12 @@ class ServingEngine:
                 return True  # every live slot was shed under page pressure
         if self._faults is not None:
             self._faults.before_decode(self)
+        # snapshot BEFORE dispatch/emission: finished requests leave
+        # _slot_req during _emit, but their pages were walked this round
+        kernel_cost = (
+            self._kernel_step_cost(k, 1, extra=k - 1)
+            if self._kernel_costed else None
+        )
         self._note_forensics(
             "decode_step" if k == 1 else f"decode_burst{k}",
             {"tokens": self._tokens, "lengths": self._lengths,
@@ -1640,6 +1707,8 @@ class ServingEngine:
                 # the roofline row keeps accumulating in burst mode instead
                 # of splitting into an uncaptured decode_burst<k> row
                 costs.note_wall("decode_step", wall, calls=k)
+                if kernel_cost is not None:
+                    costs.note_dynamic("paged_decode_kernel", wall, **kernel_cost)
         return True
 
     def _emit(self, req: Request, token: int, now: float):
@@ -1765,6 +1834,7 @@ class ServingEngine:
             out["serving/pages_total"] = self.num_pages
             out["serving/page_size"] = self.page_size
             out["serving/page_forks"] = self.page_forks
+            out["serving/decode_kernel_active"] = bool(self._kernel_costed)
             if self._prefix is not None:
                 out["serving/prefix_hit_ratio"] = self._prefix.hit_ratio
                 out["serving/prefix_hit_tokens"] = self._prefix.hit_tokens

@@ -969,6 +969,137 @@ def _serving_paged_bench(cfg, prompt_len, *, flat_slots=4, page_size=16,
     return out
 
 
+def _serving_ragged_bench(cfg, prompt_len, *, num_slots=8, page_size=16,
+                          max_new=48, steps_per_call=8, short_frac=0.75):
+    """Occupancy/raggedness sweep for the pallas paged decode kernel
+    (ops/attention): batched decode tokens/s at FULL occupancy with mixed
+    lengths — 75% short slots (prompt_len/8) / 25% long (prompt_len) — the
+    regime where the masked-dense read wastes the most bandwidth (every
+    slot streams its whole arena reservation regardless of live length).
+
+    TPU branch: runs the identical wave with the kernel (default dispatch)
+    and with ``decode_kernel='dense'`` forced, publishing
+    `decode_paged_kernel_speedup` (asserted >= 1.0) plus the kernel wave's
+    `decode_ragged_tokens_per_sec`. CPU branch: the compiled kernel cannot
+    run, so it publishes the dense wave's throughput and an
+    interpret-mode PARITY witness instead (`decode_paged_kernel_parity`:
+    kernel tokens == dense tokens on a tiny model, greedy and exact).
+    """
+    import dataclasses
+
+    from accelerate_tpu.models import DecoderConfig, DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving import ServingEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    cap = -(-(prompt_len + max_new) // page_size) * page_size
+    assert cap <= cfg.max_seq_len, (cap, cfg.max_seq_len)
+    if on_tpu and ((cfg.head_dim or 0) % 128 or page_size % 8):
+        # the compiled kernel's shape gate (head_dim % 128, page % 8):
+        # promote the sweep model so the row measures kernel-vs-dense,
+        # not dense-vs-dense noise — published so the provenance is clear
+        cfg = dataclasses.replace(cfg, head_dim=128)
+        page_size = max(page_size, 8)
+    rng = np.random.RandomState(0)
+    n_long = max(1, int(round(num_slots * (1 - short_frac))))
+    lengths = [prompt_len if i < n_long else max(page_size, prompt_len // 8)
+               for i in range(num_slots)]
+    prompts = [rng.randint(0, cfg.vocab_size, (l,)) for l in lengths]
+    out = {
+        "num_slots": num_slots, "page_size": page_size,
+        "short_frac": round(1 - n_long / num_slots, 3),
+        "short_len": min(lengths), "long_len": max(lengths),
+    }
+
+    def wave_tps(base_cfg, decode_kernel):
+        wcfg = dataclasses.replace(base_cfg, max_cache_len=cap,
+                                   decode_kernel=decode_kernel)
+        model_def = DecoderLM(wcfg)
+        variables = model_def.init_variables(
+            jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len
+        )
+        params, _ = unbox_params(variables["params"])
+        params = jax.device_put(
+            jax.tree_util.tree_map(lambda x: x.astype(wcfg.dtype), params)
+        )
+        engine = ServingEngine(
+            model_def, params, num_slots=num_slots, max_cache_len=cap,
+            prefill_chunks=(max(16, prompt_len // 4), prompt_len),
+            page_size=page_size, prefix_cache=False,
+            steps_per_call=steps_per_call,
+        )
+        engine.telemetry = None
+        engine.warmup()
+        engine.generate_batched(prompts[:2], max_new_tokens=4)  # host warm
+        engine.mark_steady()
+        engine._step_samples.clear()
+        streams = engine.generate_batched(prompts, max_new_tokens=max_new)
+        assert engine.admission_recompiles == 0
+        samples = list(engine._step_samples)
+        wall = sum(w for w, _, _ in samples)
+        toks = sum(t for _, t, _ in samples)
+        return (toks / wall if wall else None), streams, engine._kernel_costed
+
+    if on_tpu:
+        kernel_tps, kernel_streams, kernel_on = wave_tps(cfg, None)
+        dense_tps, dense_streams, _ = wave_tps(cfg, "dense")
+        # NOTE: no token-equality assert between the waves — kernel and
+        # dense logits agree to reassociation-level noise, not bitwise,
+        # so a near-tie argmax may legitimately flip on real hardware.
+        # Exactness is the op/serving test suite's contract (interpret
+        # mode, structurally matched walks); the bench's contract is the
+        # speedup. Same generated LENGTH is still required (greedy, no
+        # eos): a mismatch means a scheduling bug, not numerics.
+        assert [len(s) for s in kernel_streams] == [len(s) for s in dense_streams]
+        out["decode_ragged_tokens_per_sec"] = round(kernel_tps, 1)
+        out["decode_ragged_tokens_per_sec_dense"] = round(dense_tps, 1)
+        if not kernel_on:
+            # pallas missing from this TPU build: both waves ran dense —
+            # a speedup row here would be noise masquerading as signal
+            out["decode_paged_kernel_speedup"] = None
+            out["decode_paged_kernel_active"] = False
+            return out
+        speedup = kernel_tps / dense_tps
+        assert speedup >= 1.0, (
+            f"paged decode kernel ({kernel_tps:.1f} tok/s) lost to the "
+            f"gathered masked-dense path ({dense_tps:.1f} tok/s) on the "
+            "ragged-occupancy wave — the live-token walk must not regress"
+        )
+        out["decode_paged_kernel_speedup"] = round(speedup, 2)
+    else:
+        dense_tps, _, _ = wave_tps(cfg, "dense")
+        out["decode_ragged_tokens_per_sec"] = (
+            round(dense_tps, 1) if dense_tps else None
+        )
+        out["decode_paged_kernel_speedup"] = None  # compiled kernel is TPU-only
+        # interpret-mode parity witness on a tiny model: the kernel wave's
+        # greedy tokens must equal the dense wave's, token for token
+        tiny = DecoderConfig.tiny(max_seq_len=64)
+        t_rng = np.random.RandomState(1)
+        t_prompts = [t_rng.randint(3, tiny.vocab_size, (l,)) for l in (12, 4, 9)]
+        tiny_waves = {}
+        for mode in ("interpret", "dense"):
+            tcfg = dataclasses.replace(tiny, decode_kernel=mode,
+                                       decode_kernel_block=8)
+            t_model = DecoderLM(tcfg)
+            t_vars = t_model.init_variables(
+                jax.random.PRNGKey(0), batch_size=1, seq_len=12
+            )
+            t_params, _ = unbox_params(t_vars["params"])
+            t_engine = ServingEngine(
+                t_model, t_params, num_slots=2, max_cache_len=64,
+                prefill_chunks=(32,), page_size=8, prefix_cache=False,
+            )
+            t_engine.telemetry = None
+            tiny_waves[mode] = t_engine.generate_batched(
+                t_prompts, max_new_tokens=6
+            )
+        for a, b in zip(tiny_waves["interpret"], tiny_waves["dense"]):
+            np.testing.assert_array_equal(a, b)
+        out["decode_paged_kernel_parity"] = True
+    return out
+
+
 def _serving_isolation_bench(cfg, prompt_len, *, page_size=16, num_slots=2,
                              storm_reqs=4, b_reqs=4, max_new=12,
                              chunk_delay_s=0.004):
@@ -1321,6 +1452,18 @@ def main():
         extra["spec_accept_rate"] = extra["serving_paged"]["spec_accept_rate"]
         extra["arena_hbm_bytes_per_slot"] = extra["serving_paged"]["arena_hbm_bytes_per_slot"]
 
+        # ragged-occupancy decode: the pallas paged kernel vs the gathered
+        # masked-dense read at 75% short / 25% long slots (asserted >= 1x)
+        extra["serving_ragged"] = _serving_ragged_bench(
+            ttft_cfg, 128, num_slots=8, page_size=64, max_new=48,
+        )
+        extra["decode_ragged_tokens_per_sec"] = (
+            extra["serving_ragged"]["decode_ragged_tokens_per_sec"]
+        )
+        extra["decode_paged_kernel_speedup"] = (
+            extra["serving_ragged"]["decode_paged_kernel_speedup"]
+        )
+
         # multi-tenant isolation under a seeded prefill storm (scheduler):
         # tenant B's ITL p99 clean vs under-storm, preempt/shed actions
         extra["serving_isolation"] = _serving_isolation_bench(
@@ -1408,6 +1551,16 @@ def main():
         extra["decode_spec_tokens_per_sec"] = extra["serving_paged"]["decode_spec_tokens_per_sec"]
         extra["spec_accept_rate"] = extra["serving_paged"]["spec_accept_rate"]
         extra["arena_hbm_bytes_per_slot"] = extra["serving_paged"]["arena_hbm_bytes_per_slot"]
+        extra["serving_ragged"] = _serving_ragged_bench(
+            DecoderConfig.tiny(max_seq_len=256), 32, num_slots=4,
+            page_size=16, max_new=12, steps_per_call=4,
+        )
+        extra["decode_ragged_tokens_per_sec"] = (
+            extra["serving_ragged"]["decode_ragged_tokens_per_sec"]
+        )
+        extra["decode_paged_kernel_speedup"] = (
+            extra["serving_ragged"]["decode_paged_kernel_speedup"]
+        )
         extra["serving_isolation"] = _serving_isolation_bench(
             DecoderConfig.tiny(max_seq_len=256), 32, page_size=16,
             num_slots=2, storm_reqs=3, b_reqs=3, max_new=8,

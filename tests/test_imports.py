@@ -69,6 +69,22 @@ class TestNoEagerHeavyImports:
             "assert not heavy, f'explanatory-telemetry import pulled {heavy}'"
         )
 
+    def test_decode_kernel_code_stays_pallas_free(self):
+        """The decode-attention kernel code (ops entry + the serving
+        engine that dispatches it) must defer pallas to first trace via
+        the _LazyModule pattern: pallas costs ~0.2 s at import time —
+        billed to every worker's proc_startup_imports — and CPU-only
+        jaxlib builds may lack the TPU backend entirely."""
+        _probe(
+            "import sys\n"
+            "import accelerate_tpu\n"
+            "import accelerate_tpu.ops\n"
+            "import accelerate_tpu.ops.attention\n"
+            "import accelerate_tpu.serving.engine\n"
+            "bad = sorted(m for m in sys.modules if 'pallas' in m)\n"
+            "assert not bad, f'ops/serving import pulled pallas: {bad}'"
+        )
+
     def test_paged_kv_bookkeeping_stays_light(self):
         """The paged-arena host layer (free list, refcounts, prefix-cache
         hashing, n-gram drafter) is what a router/scheduler tier imports to
