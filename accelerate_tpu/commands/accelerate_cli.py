@@ -20,7 +20,7 @@ def main(argv=None):
 
     env.register(subparsers)
     registered = {"env"}
-    for name in ("config", "launch", "estimate", "merge", "test", "tpu_config", "trace", "report"):
+    for name in ("config", "launch", "estimate", "merge", "test", "tpu_config", "trace", "report", "watch"):
         try:
             module = __import__(f"accelerate_tpu.commands.{name}", fromlist=["register"])
             module.register(subparsers)

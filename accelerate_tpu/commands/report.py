@@ -9,6 +9,10 @@ time go and why". It merges everything a session leaves behind —
     metrics-host<i>.jsonl    per-step records (optional)
     requests-host<i>.jsonl   serving request log (optional)
 
+    timeline-host<i>.jsonl   continuous gauge timeline (sampled rollups)
+    alerts-host<i>.jsonl     alert lifecycle events (pending/firing/resolved)
+    usage-host<i>.json       per-tenant usage accounting
+
 — into one explanation:
 
     accelerate-tpu report runs/exp/telemetry
@@ -16,9 +20,16 @@ time go and why". It merges everything a session leaves behind —
 
 The text form prints the goodput breakdown (fractions sum to 1.0), the
 top executables by measured wall with their roofline class and cost-model
-MFU / bandwidth utilization, and every recompile with the exact argument
-and aval change that caused it. Pure stdlib + the telemetry host modules:
-no jax import, so it runs anywhere the artifacts land.
+MFU / bandwidth utilization, every recompile with the exact argument and
+aval change that caused it, the timeline's headline series, the alert
+history, and the per-tenant usage table. Pure stdlib + the telemetry
+host modules: no jax import, so it runs anywhere the artifacts land.
+
+``--diff A B`` is the regression sentry: it flattens two runs' metrics
+(telemetry dirs, dirs holding ``BENCH_r*.json``, or bench JSON files
+directly) and flags every shared metric that moved more than
+``--threshold`` — turning the bench trajectory into a checkable
+artifact (``--fail`` exits non-zero when anything is flagged).
 """
 
 from __future__ import annotations
@@ -187,6 +198,62 @@ def load_steps(target: str) -> dict:
     }
 
 
+# the series the text report (and `watch`) treat as headliners — shown
+# first when present; every other sampled key stays in --json
+NOTABLE_TIMELINE_KEYS = (
+    "serving/tokens_per_s", "serving/itl_recent_p99_ms",
+    "serving/ttft_p99_ms", "serving/queue_depth", "serving/slot_occupancy",
+    "serving/pages_in_use", "serving/shed", "goodput/goodput_frac",
+    "sys/tokens_per_s", "sys/mfu_pct", "alerts/firing_count",
+)
+
+
+def load_timeline_summary(target: str) -> dict:
+    """Full-span stats per sampled gauge out of ``timeline-host*.jsonl``
+    (merged across hosts): {samples, span_s, keys: {key: {last, mean,
+    min, max, n}}}."""
+    if not _host_files(target, "timeline-host*.jsonl"):
+        return {}
+    from ..telemetry.timeline import load_timeline
+
+    tl = load_timeline(target)
+    if tl.sample_count == 0 or tl.last_t is None:
+        return {}
+    keys = {}
+    span = 0.0
+    for key in tl.keys():
+        w = tl.window(key, float("inf"), now=tl.last_t)
+        if not w:
+            continue
+        span = max(span, w["span_s"])
+        keys[key] = {
+            "last": round(w["last"], 4),
+            "mean": round(w["mean"], 4) if w["mean"] is not None else None,
+            "min": round(w["min"], 4),
+            "max": round(w["max"], 4),
+            "n": w["n"],
+        }
+    return {"samples": tl.sample_count, "span_s": round(span, 1), "keys": keys}
+
+
+def load_alert_summary(target: str) -> dict:
+    """Alert history out of ``alerts-host*.jsonl``: per-rule final state
+    + fired/resolved counts, plus the raw event list."""
+    if not _host_files(target, "alerts-host*.jsonl"):
+        return {}
+    from ..telemetry.alerts import load_alerts
+
+    return load_alerts(target)
+
+
+def load_usage_table(target: str) -> dict:
+    if not _host_files(target, "usage-host*.json"):
+        return {}
+    from ..telemetry.usage import load_usage
+
+    return load_usage(target)
+
+
 def load_report(target: str) -> dict:
     forensics = load_forensics(target)
     data = {
@@ -197,6 +264,9 @@ def load_report(target: str) -> dict:
         "first_compiles": [r for r in forensics
                            if r.get("event") == "first_compile"],
         "steps": load_steps(target),
+        "timeline": load_timeline_summary(target),
+        "alerts": load_alert_summary(target),
+        "usage": load_usage_table(target),
     }
     req_files = _host_files(target, "requests-host*.jsonl")
     if req_files:
@@ -209,6 +279,15 @@ def load_report(target: str) -> dict:
 def _bar(frac: float) -> str:
     n = int(round(max(0.0, min(frac, 1.0)) * BAR_WIDTH))
     return "#" * n + "." * (BAR_WIDTH - n)
+
+
+def render_table(rows, indent: str = "  ") -> list:
+    """Column-aligned text lines for a [header, *rows] tuple list (the
+    one table renderer every section — and `watch` — shares)."""
+    rows = [tuple(str(c) for c in r) for r in rows]
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return [indent + "  ".join(c.ljust(w) for c, w in zip(r, widths))
+            for r in rows]
 
 
 def format_report(data: dict) -> str:
@@ -259,9 +338,7 @@ def format_report(data: dict) -> str:
                 f"{bw:.2f}%" if bw is not None else "",
                 f"{gbps:.1f}" if gbps is not None else "",
             ))
-        widths = [max(len(r[i]) for r in table) for i in range(len(header))]
-        for r in table:
-            lines.append("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)))
+        lines.extend(render_table(table))
     else:
         lines.append("executables: no costs-host*.json found")
 
@@ -301,13 +378,211 @@ def format_report(data: dict) -> str:
             + (f", ttft p50/p99 = {req.get('ttft_p50_ms')}/{req.get('ttft_p99_ms')} ms"
                if req.get("ttft_p50_ms") is not None else "")
         )
+
+    tl = data.get("timeline") or {}
+    if tl.get("samples"):
+        lines.append("")
+        lines.append(
+            f"timeline: {tl['samples']} samples over {tl.get('span_s', 0)}s "
+            "(timeline-host*.jsonl)"
+        )
+        keys = tl.get("keys") or {}
+        shown = [k for k in NOTABLE_TIMELINE_KEYS if k in keys]
+        for key in shown:
+            s = keys[key]
+            lines.append(
+                f"  {key:<32} last {s['last']:>10}  mean {s['mean']:>10}  "
+                f"max {s['max']:>10}"
+            )
+        rest = len(keys) - len(shown)
+        if rest > 0:
+            lines.append(f"  (+{rest} more sampled series in --json)")
+
+    alerts = data.get("alerts") or {}
+    rules = alerts.get("rules") or {}
+    if rules:
+        firing = sorted(n for n, r in rules.items() if r.get("state") == "firing")
+        fired_total = sum(r.get("fired_count", 0) for r in rules.values())
+        lines.append("")
+        lines.append(
+            f"alerts: {len(firing)} firing, {fired_total} fired over the "
+            f"session ({len(alerts.get('events') or [])} lifecycle events)"
+        )
+        for name in sorted(rules, key=lambda n: (rules[n].get("state") != "firing", n)):
+            r = rules[name]
+            lines.append(
+                f"  [{r.get('state', '?'):>7}] {name}  fired {r.get('fired_count', 0)}x"
+                + (f", last value {r.get('last_value')}"
+                   if r.get("last_value") is not None else "")
+            )
+
+    usage = data.get("usage") or {}
+    tenants = usage.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines.append(f"tenant usage ({len(tenants)} tenant(s), "
+                     f"{usage.get('hosts', 1)} host(s)):")
+        cols = ("prefill_tokens", "decode_tokens", "prefix_hit_tokens",
+                "page_seconds", "compute_ms", "finished", "shed",
+                "preempted", "cancelled")
+        header = ("tenant",) + tuple(c.replace("_tokens", "_tok") for c in cols)
+        table = [header]
+        order = sorted(tenants, key=lambda t: -(tenants[t].get("decode_tokens") or 0))
+        for name in order:
+            row = tenants[name]
+            table.append((name,) + tuple(
+                f"{row.get(c, 0):.1f}" if isinstance(row.get(c), float)
+                else str(row.get(c, 0)) for c in cols
+            ))
+        lines.extend(render_table(table))
+    return "\n".join(lines)
+
+
+# -- the regression sentry (`report --diff A B`) ----------------------------
+
+
+def _flatten_numeric(obj, prefix: str, out: dict):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten_numeric(v, f"{prefix}.{k}" if prefix else str(k), out)
+    elif isinstance(obj, bool):
+        return
+    elif isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+
+
+def _bench_metrics(path: str) -> dict:
+    """Flat metrics from one BENCH_r*.json (the driver's shape: headline
+    `parsed.metric/value` plus the `parsed.extra` tree) or any plain
+    metric-tree JSON."""
+    data = _load_json(path)
+    if not isinstance(data, dict):
+        return {}
+    out: dict = {}
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        if isinstance(parsed.get("value"), (int, float)):
+            out[str(parsed["metric"])] = float(parsed["value"])
+        _flatten_numeric(parsed.get("extra") or {}, "", out)
+    else:
+        _flatten_numeric(data, "", out)
+    # per-attempt lists and wall-clock stamps are noise, not metrics
+    return {k: v for k, v in out.items()
+            if not k.endswith(("_attempts", "time_unix_s"))}
+
+
+def collect_diff_metrics(target: str) -> dict:
+    """One side of a diff, flattened to {metric: float}: a bench JSON
+    file, a dir holding ``BENCH_r*.json`` (newest wins), or a telemetry
+    artifact dir (goodput fractions, roofline rows, request/step
+    summaries, timeline means, usage totals)."""
+    if os.path.isfile(target):
+        return _bench_metrics(target)
+    bench = sorted(glob.glob(os.path.join(target, "BENCH_r*.json")))
+    if bench:
+        return _bench_metrics(bench[-1])
+    data = load_report(target)
+    out: dict = {}
+    for b, f in (data["goodput"].get("fractions") or {}).items():
+        out[f"goodput/{b}_frac"] = float(f)
+    for row in data["costs"].get("executables") or []:
+        name = row.get("name")
+        for field in ("mfu_model_pct", "bw_util_pct", "hbm_gbps", "arith_intensity"):
+            if isinstance(row.get(field), (int, float)):
+                out[f"exe/{name}/{field}"] = float(row[field])
+    _flatten_numeric(data.get("steps") or {}, "steps", out)
+    _flatten_numeric(data.get("requests") or {}, "requests", out)
+    for key, s in ((data.get("timeline") or {}).get("keys") or {}).items():
+        if isinstance(s.get("mean"), (int, float)):
+            out[f"timeline/{key}/mean"] = float(s["mean"])
+    for tenant, row in ((data.get("usage") or {}).get("tenants") or {}).items():
+        _flatten_numeric(row, f"usage/{tenant}", out)
+    out["recompiles_diagnosed"] = float(len(data.get("recompiles") or []))
+    return out
+
+
+def diff_metrics(a: dict, b: dict, threshold: float = 0.1,
+                 min_abs: float = 1e-9) -> dict:
+    """Shared-metric comparison: relative change per metric, the ones
+    past ``threshold`` flagged (sorted, biggest mover first)."""
+    shared = sorted(set(a) & set(b))
+    rows = []
+    for key in shared:
+        va, vb = a[key], b[key]
+        if abs(va - vb) <= min_abs:
+            rel = 0.0
+        elif abs(va) <= min_abs:
+            # moved off zero: no finite relative change exists — flag it
+            # as `from_zero` with rel_change None (json.dumps(inf) would
+            # emit the non-spec `Infinity` token and break --json consumers)
+            rel = None
+        else:
+            rel = (vb - va) / abs(va)
+        rows.append({"metric": key, "a": va, "b": vb,
+                     "rel_change": round(rel, 4) if rel is not None else None,
+                     "from_zero": rel is None})
+    flagged = [r for r in rows
+               if r["from_zero"] or abs(r["rel_change"]) > threshold]
+    flagged.sort(key=lambda r: -(float("inf") if r["from_zero"]
+                                 else abs(r["rel_change"])))
+    return {
+        "shared_metrics": len(shared),
+        "only_a": sorted(set(a) - set(b)),
+        "only_b": sorted(set(b) - set(a)),
+        "threshold": threshold,
+        "flagged": flagged,
+        "rows": rows,
+    }
+
+
+def format_diff(diff: dict, a_name: str, b_name: str) -> str:
+    lines = [f"== accelerate-tpu report --diff: {a_name} vs {b_name} =="]
+    lines.append(
+        f"{diff['shared_metrics']} shared metrics, threshold "
+        f"{100 * diff['threshold']:.0f}% — {len(diff['flagged'])} flagged"
+    )
+    if diff["flagged"]:
+        table = [("metric", "A", "B", "change")]
+        for r in diff["flagged"][:40]:
+            rel = r["rel_change"]
+            table.append((
+                r["metric"], f"{r['a']:.4g}", f"{r['b']:.4g}",
+                "from zero" if r["from_zero"] else f"{100 * rel:+.1f}%",
+            ))
+        lines.extend(render_table(table))
+    else:
+        lines.append("  no shared metric moved past the threshold")
+    if diff["only_a"] or diff["only_b"]:
+        lines.append(
+            f"  (unshared: {len(diff['only_a'])} only in A, "
+            f"{len(diff['only_b'])} only in B)"
+        )
     return "\n".join(lines)
 
 
 def report_command(args) -> int:
+    if args.diff:
+        a_path, b_path = args.diff
+        a, b = collect_diff_metrics(a_path), collect_diff_metrics(b_path)
+        if not a or not b:
+            missing = a_path if not a else b_path
+            print(f"report --diff: no metrics found under {missing} — "
+                  "expected BENCH_r*.json or telemetry artifacts",
+                  file=sys.stderr)
+            return 1
+        diff = diff_metrics(a, b, threshold=args.threshold)
+        if args.json:
+            print(json.dumps(diff))
+        else:
+            print(format_diff(diff, a_path, b_path))
+        return 1 if (args.fail and diff["flagged"]) else 0
+    if not args.target:
+        print("report: pass a telemetry dir (or --diff A B)", file=sys.stderr)
+        return 1
     data = load_report(args.target)
     if not (data["goodput"] or data["costs"].get("executables")
-            or data["recompiles"] or data["first_compiles"] or data["steps"]):
+            or data["recompiles"] or data["first_compiles"] or data["steps"]
+            or data["timeline"] or data["usage"] or data["alerts"]):
         print(f"no telemetry artifacts found under {args.target} — expected "
               "goodput-host*.json / costs-host*.json / forensics-host*.jsonl "
               "(see docs/telemetry.md)", file=sys.stderr)
@@ -323,9 +598,19 @@ def register(subparsers):
     parser = subparsers.add_parser(
         "report",
         help="Explain a telemetry dir: goodput breakdown, per-executable "
-             "roofline rows, diagnosed recompiles",
+             "roofline rows, diagnosed recompiles, timeline/alerts/usage "
+             "(--diff A B = regression sentry)",
     )
-    parser.add_argument("target", help="telemetry dir (goodput/costs/forensics artifacts)")
+    parser.add_argument("target", nargs="?", default=None,
+                        help="telemetry dir (goodput/costs/forensics/"
+                             "timeline/alerts/usage artifacts)")
     parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                        help="diff two runs (telemetry dirs, bench dirs, or "
+                             "BENCH_r*.json files); flags moved metrics")
+    parser.add_argument("--threshold", type=float, default=0.1,
+                        help="relative change that flags a metric (default 0.10)")
+    parser.add_argument("--fail", action="store_true",
+                        help="exit 1 when --diff flags any metric (CI sentry)")
     parser.set_defaults(func=report_command)
     return parser

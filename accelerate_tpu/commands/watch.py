@@ -1,0 +1,317 @@
+"""`accelerate-tpu watch` — a live terminal dashboard over the ops plane.
+
+`report` explains a finished run; `watch` shows a running one: sparkline
+history for the key serving/training gauges, currently-firing alerts,
+and the per-tenant usage table — refreshed in place, pure stdlib, no jax
+(locked by tests/test_imports.py), so it runs from any shell that can
+reach the scrape endpoint or the artifact dir.
+
+Two data sources:
+
+    accelerate-tpu watch http://localhost:9109/metrics   # live scrape
+    accelerate-tpu watch runs/exp/telemetry              # timeline files
+
+- **URL mode** polls the Prometheus exposition the session already
+  serves (``TelemetryConfig(exporter_port=...)``), accumulating history
+  client-side — no server-side state beyond the existing endpoint.
+- **Dir mode** tails ``timeline-host*.jsonl`` / ``alerts-host*.jsonl`` /
+  ``usage-host*.json``, so it also works *offline* after the run (or on
+  a log-only machine), replaying whatever history the files hold.
+
+``--once`` renders a single frame and exits (scripting / tests);
+``--series`` overrides which gauges get sparklines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+SPARK_CHARS = " ▁▂▃▄▅▆▇█"
+DEFAULT_SERIES = (
+    "serving/tokens_per_s",
+    "serving/itl_recent_p99_ms",
+    "serving/ttft_p99_ms",
+    "serving/queue_depth",
+    "serving/slot_occupancy",
+    "serving/pages_in_use",
+    "goodput/goodput_frac",
+    "sys/tokens_per_s",
+    "sys/mfu_pct",
+)
+USAGE_COLUMNS = (
+    "prefill_tokens", "decode_tokens", "page_seconds", "compute_ms",
+    "finished", "shed", "preempted",
+)
+
+
+def sparkline(values, width: int = 32) -> str:
+    """Scale a series onto ``width`` block characters (flat series render
+    mid-height so a constant gauge is visibly alive, not empty)."""
+    vals = [v for v in values if isinstance(v, (int, float))]
+    if not vals:
+        return " " * width
+    if len(vals) > width:
+        stride = len(vals) / width
+        vals = [vals[int(i * stride)] for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    out = []
+    for v in vals:
+        if span <= 0:
+            out.append(SPARK_CHARS[4])
+        else:
+            idx = 1 + int((v - lo) / span * (len(SPARK_CHARS) - 2))
+            out.append(SPARK_CHARS[min(idx, len(SPARK_CHARS) - 1)])
+    return "".join(out).ljust(width)
+
+
+def _fmt_num(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != v:
+            return "nan"
+        if abs(v) >= 1000:
+            return f"{v:,.0f}"
+        return f"{v:.3g}"
+    return str(v)
+
+
+# -- URL mode: parse the Prometheus exposition back into flat gauges -------
+
+
+def parse_prometheus(text: str) -> tuple:
+    """→ ``(gauges, alerts)``: ``att_*`` gauge lines as a flat dict (the
+    ``att_`` prefix stripped), and ``att_alert_firing{rule=...}`` series
+    as ``{rule: 0/1}``."""
+    gauges, alerts = {}, {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        name = name.strip()
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        if name.startswith("att_alert_firing{"):
+            rule = name[len("att_alert_firing{"):].rstrip("}")
+            if rule.startswith('rule="') and rule.endswith('"'):
+                rule = rule[len('rule="'):-1]
+                alerts[rule.replace('\\"', '"').replace("\\\\", "\\")] = int(v)
+            continue
+        if "{" in name:  # histogram buckets: the _p50/_p95/_p99 gauges suffice
+            continue
+        if name.startswith("att_"):
+            gauges[name[len("att_"):]] = v
+    return gauges, alerts
+
+
+def fetch_metrics(url: str, timeout_s: float = 5.0) -> tuple:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return parse_prometheus(resp.read().decode("utf-8", "replace"))
+
+
+def _match_series(available, wanted) -> list:
+    """Map wanted timeline keys onto exposition-flattened names, using
+    THE exporter's own sanitizer so the two can never drift."""
+    from ..telemetry.exporter import PREFIX, _metric_name
+
+    out = []
+    for key in wanted:
+        flat = _metric_name(key)[len(PREFIX):]
+        if key in available:
+            out.append(key)
+        elif flat in available:
+            out.append(flat)
+    return out
+
+
+def _usage_rows_from_gauges(gauges: dict) -> dict:
+    """Reassemble the per-tenant table from flattened ``usage_*`` gauge
+    names (suffix-matched: tenant ids may themselves contain ``_``)."""
+    rows: dict = {}
+    for name, v in gauges.items():
+        if not name.startswith("usage_"):
+            continue
+        body = name[len("usage_"):]
+        for f in USAGE_COLUMNS + ("submitted", "cancelled", "prefix_hit_tokens"):
+            suffix = "_" + f
+            if body.endswith(suffix):
+                tenant = body[: -len(suffix)]
+                if tenant and tenant != "tenants":
+                    rows.setdefault(tenant, {})[f] = v
+                break
+    return rows
+
+
+# -- dir mode ---------------------------------------------------------------
+
+
+def load_dir_frame(target: str, span_s: float = 600.0) -> dict:
+    """One frame's data from the artifact dir: per-key history out of the
+    timeline files, alert states out of the event log, the tenant table
+    out of the usage snapshots."""
+    from ..telemetry.alerts import load_alerts
+    from ..telemetry.timeline import load_timeline
+    from ..telemetry.usage import load_usage
+
+    tl = load_timeline(target)
+    now = tl.last_t
+    history = {}
+    gauges = {}
+    if now is not None:
+        for key in tl.keys():
+            pts = tl.series(key, span_s, now=now)
+            if pts:
+                history[key] = [v for _, v in pts]
+                gauges[key] = history[key][-1]
+    alerts_data = load_alerts(target)
+    alerts = {
+        name: int(r.get("state") == "firing")
+        for name, r in (alerts_data.get("rules") or {}).items()
+    }
+    usage = load_usage(target)
+    return {
+        "gauges": gauges,
+        "history": history,
+        "alerts": alerts,
+        "tenants": usage.get("tenants") or {},
+        "samples": tl.sample_count,
+        "last_t": now,
+    }
+
+
+# -- rendering --------------------------------------------------------------
+
+
+def render_frame(frame: dict, series_keys, width: int = 32) -> str:
+    gauges = frame.get("gauges") or {}
+    history = frame.get("history") or {}
+    alerts = frame.get("alerts") or {}
+    tenants = frame.get("tenants") or {}
+    lines = []
+    stamp = time.strftime("%H:%M:%S")
+    src = frame.get("source", "")
+    lines.append(f"accelerate-tpu watch · {src} · {stamp}"
+                 + (f" · {frame['samples']} samples" if frame.get("samples") else ""))
+    lines.append("")
+    keys = _match_series(set(gauges) | set(history), series_keys)
+    if not keys:
+        lines.append("  (no known series yet — is the session sampling?)")
+    for key in keys:
+        hist = history.get(key) or []
+        cur = gauges.get(key, hist[-1] if hist else None)
+        lo = min(hist) if hist else None
+        hi = max(hist) if hist else None
+        lines.append(
+            f"  {key:<32} {_fmt_num(cur):>10}  {sparkline(hist, width)}"
+            f"  [{_fmt_num(lo)} .. {_fmt_num(hi)}]"
+        )
+    lines.append("")
+    if alerts:
+        firing = sorted(n for n, v in alerts.items() if v)
+        quiet = sorted(n for n, v in alerts.items() if not v)
+        if firing:
+            lines.append("  ALERTS FIRING: " + ", ".join(firing))
+        lines.append("  alerts ok: " + (", ".join(quiet) if quiet else "(none)"))
+    else:
+        lines.append("  alerts: (none configured / no events yet)")
+    if tenants:
+        from .report import render_table
+
+        lines.append("")
+        table = [("tenant",) + USAGE_COLUMNS]
+        order = sorted(
+            tenants, key=lambda t: -(tenants[t].get("decode_tokens") or 0)
+        )
+        for name in order[:12]:
+            row = tenants[name]
+            table.append((name,) + tuple(_fmt_num(row.get(c))
+                                         for c in USAGE_COLUMNS))
+        lines.extend(render_table(table))
+    return "\n".join(lines)
+
+
+def _build_frame(target: str, history: dict, span_s: float) -> dict:
+    if target.startswith(("http://", "https://")):
+        gauges, alerts = fetch_metrics(target)
+        for key, v in gauges.items():
+            history.setdefault(key, []).append(v)
+            if len(history[key]) > 240:
+                del history[key][: len(history[key]) - 240]
+        return {
+            "source": target,
+            "gauges": gauges,
+            "history": history,
+            "alerts": alerts,
+            "tenants": _usage_rows_from_gauges(gauges),
+        }
+    frame = load_dir_frame(target, span_s=span_s)
+    frame["source"] = target
+    return frame
+
+
+def watch_command(args) -> int:
+    history: dict = {}
+    series = (
+        [s.strip() for s in args.series.split(",") if s.strip()]
+        if args.series else list(DEFAULT_SERIES)
+    )
+    is_url = args.target.startswith(("http://", "https://"))
+    if not is_url and not os.path.isdir(args.target):
+        print(f"watch: {args.target} is neither a URL nor a directory",
+              file=sys.stderr)
+        return 1
+    while True:
+        try:
+            frame = _build_frame(args.target, history, args.span)
+        except Exception as e:
+            print(f"watch: cannot read {args.target}: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        text = render_frame(frame, series, width=args.width)
+        if args.once:
+            print(text)
+            return 0
+        # ANSI home+clear keeps the frame in place without flicker
+        sys.stdout.write("\x1b[H\x1b[2J" + text + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+def register(subparsers):
+    parser = subparsers.add_parser(
+        "watch",
+        help="Live terminal dashboard: gauge sparklines, firing alerts, "
+             "per-tenant usage (scrape endpoint or telemetry dir)",
+    )
+    parser.add_argument(
+        "target",
+        help="scrape URL (http://host:port/metrics) or telemetry dir "
+             "(timeline-host*.jsonl / alerts-host*.jsonl / usage-host*.json)",
+    )
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh cadence in seconds (default 2)")
+    parser.add_argument("--once", action="store_true",
+                        help="render one frame and exit (scripting)")
+    parser.add_argument("--series", default=None,
+                        help="comma-separated gauge keys to sparkline "
+                             "(default: the serving/goodput headliners)")
+    parser.add_argument("--span", type=float, default=600.0,
+                        help="dir mode: history window seconds (default 600)")
+    parser.add_argument("--width", type=int, default=32,
+                        help="sparkline width in characters")
+    parser.set_defaults(func=watch_command)
+    return parser

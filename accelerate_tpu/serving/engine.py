@@ -772,6 +772,9 @@ class ServingEngine:
             # before the queue append: serve() admits from another thread,
             # and admission must find the tracer record already live
             tr.on_submit(req)
+        usage = self._usage()
+        if usage is not None:
+            usage.note_submit(req.tenant)
         if self._draining:
             self._shed(req, SHED_DRAINING)
             return req
@@ -975,7 +978,7 @@ class ServingEngine:
         self._slot_req.pop(slot, None)
         self._active[slot] = False
         if self.page_size:
-            self._release_slot_pages(slot)
+            self._release_slot_pages(slot, tenant=req.tenant)
         self._free.append(slot)
         req.slot = None
 
@@ -995,6 +998,9 @@ class ServingEngine:
             self.requests_shed += 1
         else:
             self.requests_cancelled += 1
+        usage = self._usage()
+        if usage is not None:
+            usage.note_outcome(req.tenant, outcome)
         tr = self._tracer()
         if tr is not None:
             tr.on_finish(req, reason)
@@ -1055,7 +1061,7 @@ class ServingEngine:
         req, slot = self._admitting[0], self._admitting[1]
         self._admitting = None
         if self.page_size:
-            self._release_slot_pages(slot)
+            self._release_slot_pages(slot, tenant=req.tenant)
         self._free.append(slot)
         req.slot = None
         if outcome == "shed":
@@ -1152,12 +1158,15 @@ class ServingEngine:
                 # refs, so re-admission maps them back as cache hits (and
                 # LRU eviction can still reclaim them under real pressure)
                 self._prefix.insert(replay, self._tables_host.rows[slot])
-            self._release_slot_pages(slot)
+            self._release_slot_pages(slot, tenant=req.tenant)
         self._free.append(slot)
         req.slot = None
         req.preemptions += 1
         req._resume = {"rng": rng_row}
         self.preemptions += 1
+        usage = self._usage()
+        if usage is not None:
+            usage.note_preempt(req.tenant)
         self._sched.requeue(req)
         tr = self._tracer()
         if tr is not None:
@@ -1191,6 +1200,13 @@ class ServingEngine:
         if self.telemetry is None:
             return None
         return getattr(self.telemetry, "requests", None)
+
+    def _usage(self):
+        """The session's per-tenant usage accountant, or None — the same
+        one-attribute-check contract as the tracer (telemetry/usage.py)."""
+        if self.telemetry is None:
+            return None
+        return getattr(self.telemetry, "usage", None)
 
     def _note_forensics(self, fn: str, tree):
         """Fingerprint one compiled-program dispatch for recompile
@@ -1256,6 +1272,7 @@ class ServingEngine:
         scatter per new page and one fork program per copy."""
         th = self._tables_host
         ps = self.page_size
+        usage = self._usage()
         p_hi = hi_pos // ps
         while th.alloc_count[slot] <= p_hi:
             idx = th.alloc_count[slot]
@@ -1264,6 +1281,10 @@ class ServingEngine:
             th.alloc_count[slot] = idx + 1
             self._page_tables = self._set_entry(self._page_tables, slot, idx, page)
             req.pages_allocated += 1
+            if usage is not None:
+                # growth: one more page held; a CoW fork below is held-
+                # count-neutral (fresh page replaces the shared claim)
+                usage.note_pages(req.tenant, 1)
         for idx in range(lo_pos // ps, p_hi + 1):
             page = int(th.rows[slot][idx])
             if not self._allocator.shared(page):
@@ -1310,6 +1331,7 @@ class ServingEngine:
             if hit_len == 0:
                 entry = None
             self._prefix.record_hit(hit_len, entry)
+        usage = self._usage()
         if entry is not None:
             n_map = -(-hit_len // self.page_size)
             for i in range(n_map):
@@ -1317,6 +1339,10 @@ class ServingEngine:
                 self._allocator.retain(page)
                 th.rows[slot][i] = page
             th.alloc_count[slot] = n_map
+            if usage is not None:
+                usage.note_pages(req.tenant, n_map)
+        if usage is not None and hit_len:
+            usage.note_prefix_hit(req.tenant, hit_len)
         req.prefix_hit = hit_len
         if hit_len:
             # prefill chunks the cached prefix made unnecessary (TTFT
@@ -1342,14 +1368,19 @@ class ServingEngine:
             return  # cannot happen post-prefill; guard for safety
         self._prefix.insert(req.prompt, self._tables_host.rows[slot])
 
-    def _release_slot_pages(self, slot: int):
+    def _release_slot_pages(self, slot: int, tenant: Optional[str] = None):
         """Eviction: drop the slot's page references (pages still retained
         by the prefix cache or another slot survive) and point its device
         table row back at the parking page, so a later all-inactive fused
         step can never write into a page that was reallocated."""
         th = self._tables_host
-        for page in th.slot_pages(slot):
+        pages = th.slot_pages(slot)
+        for page in pages:
             self._allocator.release(page)
+        if tenant is not None and pages:
+            usage = self._usage()
+            if usage is not None:
+                usage.note_pages(tenant, -len(pages))
         th.reset_slot(slot)
         self._page_tables = self._set_row(
             self._page_tables, slot, jnp.asarray(th.rows[slot])
@@ -1460,6 +1491,12 @@ class ServingEngine:
             tr.on_prefill_chunk(req, slot, start, bucket, t0, wall)
         if self.telemetry is not None and getattr(self.telemetry, "costs", None) is not None:
             self.telemetry.costs.note_wall(f"prefill_{bucket}", wall)
+        usage = self._usage()
+        if usage is not None:
+            # actual tokens this chunk prefilled (padding excluded) plus
+            # the dispatch wall, billed to the admitting tenant
+            usage.note_prefill(req.tenant, int(seg.size))
+            usage.note_compute(req.tenant, wall * 1e3)
         idx += 1
         if idx < len(plan):
             self._admitting[3] = idx
@@ -1591,6 +1628,7 @@ class ServingEngine:
         now = time.perf_counter()
         wall = now - t0
         self.step_count += 1
+        self._usage_note_step(wall)
         emitted = 0
         for slot, req in list(self._slot_req.items()):
             accepted = int(m_h[slot])
@@ -1683,6 +1721,7 @@ class ServingEngine:
         now = time.perf_counter()
         wall = now - t0
         self.step_count += k
+        self._usage_note_step(wall)
         emitted = 0
         for i in range(k):
             # a fused burst delivers k tokens in one host RTT; amortize the
@@ -1711,11 +1750,28 @@ class ServingEngine:
                     costs.note_dynamic("paged_decode_kernel", wall, **kernel_cost)
         return True
 
+    def _usage_note_step(self, wall_s: float):
+        """Attribute one batched decode/verify dispatch's wall across the
+        live slots' tenants, evenly — called BEFORE emission (finished
+        requests leave ``_slot_req`` during ``_emit``, but they rode this
+        dispatch)."""
+        usage = self._usage()
+        if usage is None or not self._slot_req:
+            return
+        share = wall_s * 1e3 / len(self._slot_req)
+        for req in self._slot_req.values():
+            usage.note_compute(req.tenant, share)
+
     def _emit(self, req: Request, token: int, now: float):
         req.tokens.append(token)
         self.generated_tokens += 1
         if self._sched is not None:
             self._sched.note_tokens(req.tenant, 1)
+        usage = self._usage()
+        if usage is not None:
+            # the conservation law: per-tenant decode tokens sum exactly
+            # to generated_tokens — both increment here and only here
+            usage.note_decode(req.tenant)
         gap = (now - req._last_token_t) if req._last_token_t else None
         if gap is not None:
             self._itl.append(gap)
@@ -1825,10 +1881,24 @@ class ServingEngine:
             out["serving/decode_step_ms_p50"] = 1e3 * float(
                 np.median([w / s for w, _, s in self._step_samples])
             )
+        # the terminal-outcome denominator the shed-rate burn alert
+        # divides by (telemetry/alerts.py): every request that reached an
+        # outcome, whatever it was
+        out["serving/requests_terminal"] = (
+            self.requests_completed + self.requests_shed
+            + self.requests_cancelled
+        )
         if self._itl:
             itl = np.asarray(self._itl)
             out["serving/itl_p50_ms"] = 1e3 * float(np.percentile(itl, 50))
             out["serving/itl_p95_ms"] = 1e3 * float(np.percentile(itl, 95))
+            # recent-window p99 (same observation the AIMD controller
+            # acts on): the live gauge the ITL burn-rate alert samples —
+            # unlike the lifetime histogram p99, it decays once the
+            # regression clears
+            p99, _ = self._recent_itl_p99_ms()
+            if p99 is not None:
+                out["serving/itl_recent_p99_ms"] = round(p99, 3)
         if self.page_size:
             out["serving/pages_in_use"] = self._allocator.in_use
             out["serving/pages_total"] = self.num_pages

@@ -50,6 +50,15 @@ runtime together and the engine feeds it automatically:
   and classifies each against the device roofline, attributing measured
   wall into per-fn model-MFU rows. ``accelerate-tpu report`` renders all
   three offline.
+- **continuous ops plane** — ``telemetry.timeline`` samples every rollup
+  gauge (plus histogram p50/p95/p99) on a background cadence into a
+  bounded multi-resolution ring with windowed queries;
+  ``telemetry.alerts`` evaluates threshold and multi-window SLO
+  burn-rate rules against it (pending→firing→resolved, event log,
+  ``alert_firing`` exposition, actions that dump a flight bundle or arm
+  a capture window); ``telemetry.usage`` meters per-tenant tokens, HBM
+  page-seconds, compute-ms and outcome counts. ``accelerate-tpu watch``
+  renders all three live; ``report`` renders them offline.
 
 Everything is off unless a config is passed (or ``ATT_TELEMETRY=1``);
 when off, the engine's only cost is one ``is None`` check per step.
@@ -118,6 +127,17 @@ class TelemetryConfig:
     forensics: bool = True             # recompile cause diffing + JSONL
     goodput: bool = True               # wall-clock goodput ledger
     cost_registry: bool = True         # per-executable roofline rows
+    # the continuous ops plane (docs/telemetry.md: timeline / alerting /
+    # per-tenant usage). Sampling runs on a background daemon thread at
+    # timeline_interval_s; 0 disables the thread (call
+    # session.sample_timeline() manually — what deterministic tests do).
+    timeline: bool = True
+    timeline_interval_s: float = 1.0
+    timeline_tiers: Optional[tuple] = None  # ((interval_s, capacity), ...)
+    alerts: bool = True                     # evaluate rules per sample
+    alert_rules: Optional[list] = None      # default: alerts.default_ruleset()
+    alert_itl_slo_ms: Optional[float] = None  # ITL burn-rate rule SLO
+    usage: bool = True                      # per-tenant usage accounting
     # flight recorder (docs/troubleshooting.md)
     flight_recorder: bool = True
     flight_events: int = 256               # bounded event ring capacity
@@ -321,6 +341,46 @@ class TelemetrySession:
                     window_steps=config.profile_window_steps,
                 )
 
+        # the continuous ops plane: per-tenant usage meters, the sampled
+        # timeline, and the alert rules evaluated on its cadence — built
+        # after flight/capture (alert actions reach both) and before the
+        # exporter (which renders the alert_firing series)
+        self.usage = None
+        if config.usage:
+            from .usage import UsageAccountant
+
+            self.usage = UsageAccountant()
+        self.timeline = None
+        self.alerts = None
+        self._sampler = None
+        if config.timeline:
+            from .timeline import Timeline, TimelineSampler
+
+            self.timeline = Timeline(tiers=config.timeline_tiers)
+            if config.alerts:
+                from . import alerts as _alerts
+
+                rules = config.alert_rules
+                if rules is None:
+                    slo = (
+                        config.alert_itl_slo_ms
+                        if config.alert_itl_slo_ms is not None
+                        else config.profile_trigger_itl_p99_ms
+                    )
+                    rules = _alerts.default_ruleset(itl_slo_ms=slo)
+                apath = None
+                if self.trace_dir:
+                    apath = os.path.join(
+                        self.trace_dir, f"alerts-host{self.process_index}.jsonl"
+                    )
+                self.alerts = _alerts.AlertManager(
+                    self.timeline, rules, session=self, log_path=apath,
+                )
+            if config.timeline_interval_s and config.timeline_interval_s > 0:
+                self._sampler = TimelineSampler(
+                    self.sample_timeline, config.timeline_interval_s
+                ).start()
+
         self.exporter = None
         if config.exporter_port is not None:
             from .exporter import ScrapeServer
@@ -422,6 +482,24 @@ class TelemetrySession:
             self.flight.dump("watchdog_stall", extra={"stall_report": report})
         if self.capture is not None:
             self.capture.arm("watchdog_stall")
+
+    def sample_timeline(self, now: Optional[float] = None) -> dict:
+        """One timeline tick: bring the usage integrals current, fold a
+        device-free rollup (every gauge + histogram percentiles) into the
+        timeline, and run one alert-evaluation pass. The background
+        sampler calls this every ``timeline_interval_s``; with the thread
+        off (interval 0) call it manually — ``now`` overrides the sample
+        timestamp, which is what deterministic tests use."""
+        tl = self.timeline
+        if tl is None:
+            return {}
+        values = self.host_rollup()
+        t = tl.add_sample(values, now=now)
+        if self.usage is not None:
+            self.usage.mark()
+        if self.alerts is not None:
+            self.alerts.evaluate(now=t)
+        return values
 
     def request_drain_serving(self):
         """Ask every attached serving engine to drain (flag-only: stop
@@ -701,6 +779,10 @@ class TelemetrySession:
             # there would stamp it with a partial compile delta. A pending
             # event counts once its own thread (or close()) finalizes it.
             out["sys/recompiles_diagnosed"] = len(self.forensics.recompiles())
+        if self.usage is not None:
+            out.update(self.usage.rollup_keys())
+        if self.alerts is not None:
+            out.update(self.alerts.rollup_keys())
         if self.config.device_memory:
             from .metrics import device_memory_stats
 
@@ -735,6 +817,12 @@ class TelemetrySession:
             # and this path runs on the watchdog thread against a possibly
             # wedged backend — use only already-resolved peaks
             out.update(self.costs.rollup_keys(probe=False))
+        if self.forensics is not None:
+            out["sys/recompiles_diagnosed"] = len(self.forensics.recompiles())
+        if self.usage is not None:
+            out.update(self.usage.rollup_keys())
+        if self.alerts is not None:
+            out.update(self.alerts.rollup_keys())
         return out
 
     def flush(self, step: Optional[int] = None) -> dict:
@@ -766,6 +854,13 @@ class TelemetrySession:
             if self.goodput is not None:
                 self.goodput.write_snapshot(os.path.join(
                     self.trace_dir, f"goodput-host{self.process_index}.json"))
+            if self.timeline is not None:
+                self.timeline.flush_jsonl(os.path.join(
+                    self.trace_dir,
+                    f"timeline-host{self.process_index}.jsonl"))
+            if self.usage is not None:
+                self.usage.write_snapshot(os.path.join(
+                    self.trace_dir, f"usage-host{self.process_index}.json"))
         except OSError:
             pass
 
@@ -783,6 +878,15 @@ class TelemetrySession:
                 engine.telemetry = None  # a live server must not feed a closed session
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self._sampler is not None:
+            self._sampler.stop()
+        if self.timeline is not None and self.timeline.sample_count == 0:
+            # a session shorter than the sampling interval still leaves
+            # one sample behind, so report/watch never see an empty file
+            try:
+                self.sample_timeline()
+            except Exception:
+                pass
         if self.capture is not None:
             self.capture.close()
         if self.exporter is not None:
@@ -790,6 +894,8 @@ class TelemetrySession:
         if self.flight is not None:
             self.flight.uninstall_hooks()
         self._write_artifacts()
+        if self.alerts is not None:
+            self.alerts.close()
         if self.forensics is not None:
             from . import forensics as _forensics
 

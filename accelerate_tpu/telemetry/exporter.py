@@ -2,9 +2,10 @@
 
 The tracker plumbing (JSONL/TensorBoard/W&B) is pull-from-the-run; a
 fleet operator's monitoring is pull-from-outside. This module renders the
-live :class:`TelemetrySession` — the rolling rollup gauges plus the SLO
-histograms — as Prometheus text exposition format (version 0.0.4), and
-optionally serves it from a stdlib-HTTP scrape thread:
+live :class:`TelemetrySession` — the rolling rollup gauges, the SLO
+histograms, and the alert states — as Prometheus text exposition format
+(version 0.0.4), and optionally serves it from a stdlib-HTTP scrape
+thread:
 
     session = accelerator.telemetry
     print(prometheus_text(session))            # one-shot
@@ -14,8 +15,16 @@ optionally serves it from a stdlib-HTTP scrape thread:
 Histograms are rendered natively (``_bucket{le=...}``/``_sum``/``_count``
 straight from the log-bucket layout) *plus* precomputed ``_p50/_p95/_p99``
 gauges, so dashboards that can't run ``histogram_quantile`` still get the
-SLO lines. No third-party client library: the format is plain text and
-the server is ``http.server`` in a daemon thread.
+SLO lines. Alert rules surface as ``att_alert_firing{rule="..."}`` 0/1
+series (telemetry/alerts.py). No third-party client library: the format
+is plain text and the server is ``http.server`` in a daemon thread.
+
+Exposition hardening (dynamic keys carry tenant ids and executable
+names, which the process does not control): metric names are sanitized
+to ``[a-zA-Z0-9_:]``, label values are escaped per the 0.0.4 format
+(``\\``, ``"``, newline), and a warn-once **cardinality cap** bounds a
+runaway dynamic gauge family — a scrape endpoint must degrade, never
+amplify, a tenant-id explosion.
 """
 
 from __future__ import annotations
@@ -24,13 +33,34 @@ import re
 import threading
 from typing import Optional
 
-_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+# exposition metric names allow [a-zA-Z_:][a-zA-Z0-9_:]*; the att_ prefix
+# guarantees the first character, the sub() the rest
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 PREFIX = "att_"
+
+# one process exporting more gauge series than this is a bug (a dynamic
+# key family — tenant ids, executable names — growing without bound);
+# the exposition truncates and warns once rather than melt the scraper
+MAX_SERIES = 4096
+_cardinality_warned = False
 
 
 def _metric_name(key: str) -> str:
-    """``serving/ttft_p50_ms`` -> ``att_serving_ttft_p50_ms``."""
+    """``serving/ttft_p50_ms`` -> ``att_serving_ttft_p50_ms`` (sanitized
+    to the exposition charset — tenant ids and executable names are
+    interpolated into keys and may carry anything)."""
     return PREFIX + _NAME_RE.sub("_", key.strip("/"))
+
+
+def escape_label_value(value) -> str:
+    """Label-value escaping per exposition format 0.0.4: backslash,
+    double quote, and newline."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 def _fmt(v) -> str:
@@ -45,22 +75,58 @@ def _fmt(v) -> str:
     return repr(f)
 
 
+def _warn_cardinality(n: int):
+    global _cardinality_warned
+    if _cardinality_warned:
+        return
+    _cardinality_warned = True
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "telemetry exposition holds %d gauge series (cap %d): a dynamic "
+        "key family (tenant ids? executable names?) is growing without "
+        "bound — series beyond the cap are dropped from the scrape. "
+        "Bound the producer (SchedulerConfig.max_tenants, "
+        "UsageAccountant(max_tenants=...)) instead of raising the cap.",
+        n, MAX_SERIES,
+    )
+
+
 def prometheus_text(session) -> str:
-    """Render the session's gauges + histograms as Prometheus exposition
-    text. Never raises on a sick session: a gauge source that throws is
-    skipped (a scrape must not take the serving loop down)."""
+    """Render the session's gauges + histograms + alert states as
+    Prometheus exposition text. Never raises on a sick session: a gauge
+    source that throws is skipped (a scrape must not take the serving
+    loop down)."""
     lines = []
     try:
         values = session.rollup()
     except Exception:
         values = {}
-    for key in sorted(values):
+    keys = sorted(values)
+    if len(keys) > MAX_SERIES:
+        _warn_cardinality(len(keys))
+        keys = keys[:MAX_SERIES]
+    for key in keys:
         v = values[key]
         if isinstance(v, (dict, list, tuple, str)):
             continue
         name = _metric_name(key)
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {_fmt(v)}")
+    alerts = getattr(session, "alerts", None)
+    if alerts is not None:
+        try:
+            states = alerts.states_snapshot()
+            if states:
+                lines.append(f"# TYPE {PREFIX}alert_firing gauge")
+                for rule in sorted(states):
+                    st = states[rule]
+                    lines.append(
+                        f'{PREFIX}alert_firing{{rule="{escape_label_value(rule)}"}} '
+                        f'{1 if st["state"] == "firing" else 0}'
+                    )
+        except Exception:  # alert state must not fail the scrape
+            pass
     for hname, hist in sorted(list(getattr(session, "hists", {}).items())):
         try:
             buckets = hist.cumulative_buckets()
@@ -87,16 +153,21 @@ def prometheus_text(session) -> str:
 
 class ScrapeServer:
     """``/metrics`` scrape endpoint over the live session, on a daemon
-    thread. ``port=0`` binds an ephemeral port (``.port`` says which —
-    what the tests use); bind failures degrade to a warning, never an
-    exception, because an occupied port must not kill a training run."""
+    thread. ``port=0`` binds an ephemeral port; a configured port that is
+    already in use **falls back to port 0** (the resolved port is logged
+    and exposed as ``.port``) — a stale scraper holding the port must
+    neither kill a training run nor silently cost the telemetry. Only an
+    unbindable host degrades to a warning with the endpoint disabled."""
 
     def __init__(self, session, port: int = 0, host: str = "127.0.0.1"):
         import http.server
+        import logging
 
         self.session = session
         self.server = None
         self.port: Optional[int] = None
+        self.requested_port = port
+        self._thread: Optional[threading.Thread] = None
         exporter = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -116,16 +187,32 @@ class ScrapeServer:
             def log_message(self, *args):  # scrapes must not spam stderr
                 pass
 
+        log = logging.getLogger(__name__)
         try:
             self.server = http.server.ThreadingHTTPServer((host, port), Handler)
-        except OSError as e:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "telemetry exporter could not bind %s:%s (%s); scrape "
-                "endpoint disabled", host, port, e,
-            )
-            return
+        except OSError as first_err:
+            if port:
+                try:
+                    self.server = http.server.ThreadingHTTPServer(
+                        (host, 0), Handler
+                    )
+                    log.warning(
+                        "telemetry exporter could not bind %s:%s (%s); "
+                        "fell back to ephemeral port %s",
+                        host, port, first_err, self.server.server_address[1],
+                    )
+                except OSError as e:
+                    log.warning(
+                        "telemetry exporter could not bind %s (%s); scrape "
+                        "endpoint disabled", host, e,
+                    )
+                    return
+            else:
+                log.warning(
+                    "telemetry exporter could not bind %s:%s (%s); scrape "
+                    "endpoint disabled", host, port, first_err,
+                )
+                return
         self.port = self.server.server_address[1]
         self._thread = threading.Thread(
             target=self.server.serve_forever, name="att-telemetry-exporter",
@@ -134,7 +221,12 @@ class ScrapeServer:
         self._thread.start()
 
     def close(self):
+        """Shut the scrape thread down and join it: a wedged exporter
+        thread must never be what holds the process open at exit."""
         if self.server is not None:
             self.server.shutdown()
             self.server.server_close()
             self.server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

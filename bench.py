@@ -207,6 +207,12 @@ def _train_goodput_bench(cfg, batch_size, seq_len, steps, mixed_precision,
     metrics = step(varied)
     float(jax.device_get(metrics["loss"]))
     session = accelerator.telemetry
+    # the continuous ops plane rides the same session (timeline sampler,
+    # alert rules, usage meters are on by default): force one sample so
+    # even a sub-second wave leaves a timeline artifact behind, then
+    # publish how much history the wave accrued — the recompile-storm
+    # rule sees the deliberate half-batch recompile above as data
+    session.sample_timeline()
     rollup = session.rollup()
     out = {
         "tokens_per_sec_traced": round(tok_s, 1),
@@ -214,6 +220,16 @@ def _train_goodput_bench(cfg, batch_size, seq_len, steps, mixed_precision,
         "mfu_model_pct": rollup.get("exe/train_step_mfu_model_pct"),
         "recompiles_diagnosed": rollup.get("sys/recompiles_diagnosed"),
         "overhead_pct": overhead_pct,
+        "timeline_samples": (
+            session.timeline.sample_count if session.timeline is not None
+            else None
+        ),
+        "alert_rules": (
+            len(session.alerts.rules) if session.alerts is not None else 0
+        ),
+        "alerts_firing": (
+            session.alerts.firing() if session.alerts is not None else []
+        ),
     }
     session.close()
     return out
@@ -242,6 +258,9 @@ def _publish_goodput_rows(extra, cfg, batch_size, seq_len, steps,
     extra["train_step_mfu_model"] = gp["mfu_model_pct"]
     extra["train_telemetry_overhead_pct"] = gp["overhead_pct"]
     extra["train_recompiles_diagnosed"] = gp["recompiles_diagnosed"]
+    extra["train_timeline_samples"] = gp["timeline_samples"]
+    extra["train_alert_rules"] = gp["alert_rules"]
+    extra["train_alerts_firing"] = gp["alerts_firing"]
 
 
 def _encoder_bench(batch_size, seq_len, steps):

@@ -123,3 +123,34 @@ class TestNoEagerHeavyImports:
             "import accelerate_tpu.commands.report\n"
             "assert 'jax' not in sys.modules, 'report CLI pulled jax'"
         )
+
+    def test_ops_plane_stays_light(self):
+        """The continuous ops plane — timeline ring, alert rules, usage
+        accounting — is host bookkeeping a router/monitoring tier imports
+        with no accelerator stack; jax loads only when a live session
+        probes a device."""
+        _probe(
+            "import sys\n"
+            "import accelerate_tpu.telemetry.timeline as tlm\n"
+            "import accelerate_tpu.telemetry.alerts as alerts\n"
+            "import accelerate_tpu.telemetry.usage as usage\n"
+            "tl = tlm.Timeline()\n"
+            "tl.add_sample({'x': 1.0}, now=1.0)\n"
+            "rules = alerts.default_ruleset(itl_slo_ms=25.0)\n"
+            "alerts.AlertManager(tl, rules).evaluate(now=1.0)\n"
+            "usage.UsageAccountant().note_decode('t')\n"
+            "heavy = {m for m in ('jax', 'flax', 'numpy') if m in sys.modules}\n"
+            "assert heavy <= {'numpy'}, f'ops-plane import pulled {heavy}'\n"
+            "assert 'jax' not in sys.modules and 'flax' not in sys.modules"
+        )
+
+    def test_watch_cli_module_stays_light(self):
+        """`accelerate-tpu watch` runs from any shell that can reach the
+        scrape endpoint or the artifact dir — stdlib only, no jax."""
+        _probe(
+            "import sys\n"
+            "import accelerate_tpu.commands.watch as watch\n"
+            "watch.sparkline([1.0, 2.0, 3.0], width=8)\n"
+            "watch.parse_prometheus('att_x 1.0\\n')\n"
+            "assert 'jax' not in sys.modules, 'watch CLI pulled jax'"
+        )

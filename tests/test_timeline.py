@@ -1,0 +1,657 @@
+"""Ops-plane host layer: timeline downsampling, alert lifecycle,
+per-tenant usage accounting, exporter hardening, and the report --diff
+regression sentry. Everything here is host-side bookkeeping with
+synthetic clocks — deterministic, no engine, no jax dispatch."""
+
+import json
+import logging
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.telemetry.alerts import (
+    FIRING,
+    OK,
+    PENDING,
+    AlertManager,
+    AlertRule,
+    BurnRateRule,
+    default_ruleset,
+    load_alerts,
+)
+from accelerate_tpu.telemetry.timeline import (
+    Timeline,
+    TimelineSampler,
+    load_timeline,
+)
+from accelerate_tpu.telemetry.usage import (
+    OVERFLOW_TENANT,
+    UsageAccountant,
+    load_usage,
+)
+
+
+def _fill(tl, values_fn, n, t0=1000.0, dt=1.0):
+    for i in range(n):
+        tl.add_sample(values_fn(i), now=t0 + i * dt)
+
+
+class TestTimelineDownsampling:
+    def test_raw_ring_is_bounded(self):
+        tl = Timeline(tiers=((1.0, 16), (10.0, 8), (60.0, 4)))
+        _fill(tl, lambda i: {"x": float(i)}, 10_000)
+        assert len(tl.raw) == 16
+        assert tl.sample_count == 10_000
+        for tier in tl.tiers:
+            assert len(tier.points) <= tier.points.maxlen
+
+    def test_aggregate_math_matches_numpy(self):
+        """Tier-1 bucket stats must be the exact min/max/mean/first/last
+        of the raw samples that fell in the bucket."""
+        tl = Timeline(tiers=((1.0, 4), (10.0, 64)))
+        rng = np.random.RandomState(0)
+        vals = rng.uniform(0, 100, 100)
+        # samples at t = 1000.5, 1001.5, ... -> bucket (990, 1000], (1000, 1010]...
+        for i, v in enumerate(vals):
+            tl.add_sample({"x": float(v)}, now=1000.5 + i)
+        # fully-closed buckets: samples 0..9 land in the bucket ending 1010
+        tier = tl.tiers[0]
+        t, agg = tier.points[0]
+        assert t == pytest.approx(1010.0)
+        chunk = vals[:10]  # t in (1000, 1010]
+        mn, mx, sm, n, first, last = agg["x"]
+        assert mn == pytest.approx(chunk.min())
+        assert mx == pytest.approx(chunk.max())
+        assert sm / n == pytest.approx(chunk.mean())
+        assert n == 10
+        assert first == pytest.approx(chunk[0])
+        assert last == pytest.approx(chunk[-1])
+
+    def test_window_merges_tiers_beyond_raw_coverage(self):
+        """A window wider than the raw ring still answers (from the
+        aggregate tiers), and its mean matches the full series."""
+        tl = Timeline(tiers=((1.0, 10), (10.0, 64)))
+        _fill(tl, lambda i: {"x": float(i)}, 100)
+        w = tl.window("x", 100)
+        assert w is not None
+        # raw covers only the last 10 samples; the rest came from tier 1
+        assert w["n"] > 10
+        assert w["max"] == 99.0
+        assert w["last"] == 99.0
+        assert w["mean"] == pytest.approx(np.mean(np.arange(100)[-w["n"]:]), rel=0.15)
+
+    def test_window_rate_and_delta_read_counters(self):
+        tl = Timeline(tiers=((1.0, 128),))
+        _fill(tl, lambda i: {"c": 5.0 * i}, 50)
+        w = tl.window("c", 20)
+        assert w["delta"] == pytest.approx(5.0 * (w["n"] - 1))
+        assert w["rate"] == pytest.approx(5.0)
+
+    def test_window_missing_key_is_none(self):
+        tl = Timeline()
+        _fill(tl, lambda i: {"x": 1.0}, 5)
+        assert tl.window("nope", 60) is None
+        assert tl.last("nope") is None
+        assert tl.last("x") == 1.0
+
+    def test_series_is_bounded_for_sparklines(self):
+        tl = Timeline(tiers=((1.0, 512),))
+        _fill(tl, lambda i: {"x": float(i % 7)}, 500)
+        pts = tl.series("x", 500, max_points=64)
+        assert 0 < len(pts) <= 64
+        assert all(isinstance(v, float) for _, v in pts)
+
+    def test_persistence_round_trip(self, tmp_path):
+        tl = Timeline(tiers=((1.0, 64),))
+        _fill(tl, lambda i: {"x": float(i), "y": 2.0}, 20)
+        path = str(tmp_path / "timeline-host0.jsonl")
+        assert tl.flush_jsonl(path) == 20
+        assert tl.flush_jsonl(path) == 0  # nothing new since
+        _fill(tl, lambda i: {"x": 100.0 + i}, 3, t0=2000.0)
+        assert tl.flush_jsonl(path) == 3
+        loaded = load_timeline(str(tmp_path))
+        assert loaded.sample_count == 23
+        assert loaded.window("x", 10, now=2002.0)["last"] == 102.0
+
+    def test_loader_skips_torn_lines(self, tmp_path):
+        path = tmp_path / "timeline-host0.jsonl"
+        path.write_text(
+            json.dumps({"t": 1.0, "v": {"x": 1.0}}) + "\n"
+            + "{\"t\": 2.0, \"v\": {\"x\"" + "\n"  # torn tail
+        )
+        loaded = load_timeline(str(path))
+        assert loaded.sample_count == 1
+
+    def test_sampler_thread_ticks_and_stops(self):
+        ticks = []
+        s = TimelineSampler(lambda: ticks.append(1), interval_s=0.01).start()
+        import time
+
+        deadline = time.monotonic() + 2.0
+        while not ticks and time.monotonic() < deadline:
+            time.sleep(0.005)
+        s.stop()
+        assert ticks, "sampler never ticked"
+        n = len(ticks)
+        time.sleep(0.05)
+        assert len(ticks) == n, "sampler kept ticking after stop()"
+
+
+class TestAlertRules:
+    def test_parse_threshold_expression(self):
+        r = AlertRule.parse(
+            "arena", "serving/pages_in_use / serving/pages_total > 0.9 for 30s"
+        )
+        assert r.key == "serving/pages_in_use"
+        assert r.denominator == "serving/pages_total"
+        assert r.op == ">" and r.threshold == 0.9 and r.for_s == 30.0
+        r2 = AlertRule.parse("q", "serving/queue_depth >= 100")
+        assert r2.denominator is None and r2.for_s == 0.0
+        # scientific notation with a negative exponent is a valid float
+        r3 = AlertRule.parse("tiny", "goodput/goodput_frac < 1e-3 for 30s")
+        assert r3.threshold == pytest.approx(1e-3) and r3.for_s == 30.0
+        with pytest.raises(ValueError):
+            AlertRule.parse("bad", "what even is this")
+
+    def test_threshold_lifecycle_pending_hold_firing_resolved(self, tmp_path):
+        tl = Timeline(tiers=((1.0, 256),))
+        log = str(tmp_path / "alerts-host0.jsonl")
+        fired = []
+        rule = AlertRule("hot", key="temp", op=">", threshold=50.0, for_s=3.0,
+                         actions=(lambda r, s, v: fired.append((r.name, v)),))
+        mgr = AlertManager(tl, [rule], log_path=log)
+        for i in range(5):  # healthy
+            tl.add_sample({"temp": 10.0}, now=100.0 + i)
+            mgr.evaluate(now=100.0 + i)
+        assert mgr.states["hot"].state == OK
+        tl.add_sample({"temp": 90.0}, now=105.0)
+        mgr.evaluate(now=105.0)
+        assert mgr.states["hot"].state == PENDING  # breach, hold not elapsed
+        assert not fired
+        tl.add_sample({"temp": 91.0}, now=106.0)
+        mgr.evaluate(now=106.0)
+        assert mgr.states["hot"].state == PENDING
+        tl.add_sample({"temp": 92.0}, now=108.0)
+        mgr.evaluate(now=108.0)  # 3s since pending -> firing
+        assert mgr.states["hot"].state == FIRING
+        assert fired == [("hot", 92.0)]
+        tl.add_sample({"temp": 5.0}, now=109.0)
+        mgr.evaluate(now=109.0)
+        assert mgr.states["hot"].state == OK
+        mgr.close()
+        events = [json.loads(line) for line in open(log)]
+        assert [e["state"] for e in events] == ["pending", "firing", "resolved"]
+        # and the offline loader reconstructs the rule summary
+        summary = load_alerts(str(tmp_path))
+        assert summary["rules"]["hot"]["fired_count"] == 1
+        assert summary["rules"]["hot"]["state"] == OK
+
+    def test_pending_clears_without_firing_on_recovery(self):
+        tl = Timeline(tiers=((1.0, 64),))
+        rule = AlertRule("hot", key="temp", threshold=50.0, for_s=10.0)
+        mgr = AlertManager(tl, [rule])
+        tl.add_sample({"temp": 90.0}, now=10.0)
+        mgr.evaluate(now=10.0)
+        assert mgr.states["hot"].state == PENDING
+        tl.add_sample({"temp": 1.0}, now=11.0)
+        mgr.evaluate(now=11.0)
+        assert mgr.states["hot"].state == OK
+        assert mgr.states["hot"].fired_count == 0
+        # the pending edge logs; the quiet pending->ok recovery does not
+        assert [e["state"] for e in mgr.events] == ["pending"]
+
+    def test_ratio_rule_and_zero_hold_fires_same_pass(self):
+        tl = Timeline(tiers=((1.0, 64),))
+        rule = AlertRule.parse("arena", "used / total > 0.9")
+        mgr = AlertManager(tl, [rule])
+        tl.add_sample({"used": 95.0, "total": 100.0}, now=1.0)
+        events = mgr.evaluate(now=1.0)
+        assert mgr.states["arena"].state == FIRING
+        assert [e["state"] for e in events] == ["pending", "firing"]
+
+    def test_missing_series_never_breaches(self):
+        tl = Timeline(tiers=((1.0, 64),))
+        mgr = AlertManager(tl, [AlertRule("ghost", key="not/there", threshold=1.0)])
+        tl.add_sample({"x": 1.0}, now=1.0)
+        mgr.evaluate(now=1.0)
+        assert mgr.states["ghost"].state == OK
+
+    def test_gated_rule_waits_for_gate(self):
+        tl = Timeline(tiers=((1.0, 256),))
+        rule = AlertRule("collapse", key="goodput/goodput_frac", op="<",
+                         threshold=0.5, window_s=5.0, stat="mean",
+                         gate_key="sys/tokens_per_s")
+        mgr = AlertManager(tl, [rule])
+        for i in range(8):  # idle session: goodput 0 but no throughput
+            tl.add_sample({"goodput/goodput_frac": 0.0}, now=float(i))
+            mgr.evaluate(now=float(i))
+        assert mgr.states["collapse"].state == OK
+        for i in range(8, 16):  # training live AND goodput collapsed
+            tl.add_sample({"goodput/goodput_frac": 0.1,
+                           "sys/tokens_per_s": 1000.0}, now=float(i))
+            mgr.evaluate(now=float(i))
+        assert mgr.states["collapse"].state == FIRING
+
+    def test_delta_stat_catches_recompile_storm(self):
+        tl = Timeline(tiers=((1.0, 256),))
+        rule = AlertRule("storm", key="sys/recompiles_diagnosed",
+                         stat="delta", window_s=10.0, threshold=2.0)
+        mgr = AlertManager(tl, [rule])
+        for i in range(5):
+            tl.add_sample({"sys/recompiles_diagnosed": 1.0}, now=float(i))
+            mgr.evaluate(now=float(i))
+        assert mgr.states["storm"].state == OK
+        for i in range(5, 10):
+            tl.add_sample({"sys/recompiles_diagnosed": 1.0 + i}, now=float(i))
+            mgr.evaluate(now=float(i))
+        assert mgr.states["storm"].state == FIRING
+
+
+class TestBurnRateRules:
+    def _mgr(self, **kw):
+        tl = Timeline(tiers=((1.0, 1024),))
+        kw.setdefault("fast_s", 5.0)
+        kw.setdefault("slow_s", 20.0)
+        kw.setdefault("budget", 0.1)
+        kw.setdefault("factor", 2.0)
+        rule = BurnRateRule("burn", key="lat", slo=100.0, **kw)
+        return tl, AlertManager(tl, [rule])
+
+    def test_sustained_breach_fires_and_recovery_resolves(self):
+        tl, mgr = self._mgr()
+        t = 0.0
+        for _ in range(25):  # healthy history fills the slow window
+            tl.add_sample({"lat": 10.0}, now=t)
+            mgr.evaluate(now=t)
+            t += 1.0
+        assert mgr.states["burn"].state == OK
+        for _ in range(6):  # sustained breach: fast AND slow burn
+            tl.add_sample({"lat": 500.0}, now=t)
+            mgr.evaluate(now=t)
+            t += 1.0
+        assert mgr.states["burn"].state == FIRING
+        for _ in range(8):  # recovery clears the fast window first
+            tl.add_sample({"lat": 10.0}, now=t)
+            mgr.evaluate(now=t)
+            t += 1.0
+        assert mgr.states["burn"].state == OK
+        assert mgr.states["burn"].fired_count == 1
+
+    def test_short_spike_does_not_page(self):
+        """One bad sample burns the fast window but not the slow one —
+        the two-window AND is exactly what keeps a blip silent."""
+        tl, mgr = self._mgr(budget=0.3)
+        t = 0.0
+        for _ in range(25):
+            tl.add_sample({"lat": 10.0}, now=t)
+            mgr.evaluate(now=t)
+            t += 1.0
+        tl.add_sample({"lat": 500.0}, now=t)
+        mgr.evaluate(now=t)
+        t += 1.0
+        for _ in range(4):
+            tl.add_sample({"lat": 10.0}, now=t)
+            mgr.evaluate(now=t)
+            t += 1.0
+        assert mgr.states["burn"].state == OK
+        assert mgr.states["burn"].fired_count == 0
+
+    def test_counter_mode_shed_fraction(self):
+        tl = Timeline(tiers=((1.0, 1024),))
+        rule = BurnRateRule("sheds", key="shed", total_key="terminal",
+                            budget=0.05, fast_s=5.0, slow_s=20.0, factor=2.0)
+        mgr = AlertManager(tl, [rule])
+        shed, term = 0.0, 0.0
+        t = 0.0
+        for _ in range(25):  # all requests finish
+            term += 4
+            tl.add_sample({"shed": shed, "terminal": term}, now=t)
+            mgr.evaluate(now=t)
+            t += 1.0
+        assert mgr.states["sheds"].state == OK
+        for _ in range(6):  # half of everything sheds
+            shed += 2
+            term += 4
+            tl.add_sample({"shed": shed, "terminal": term}, now=t)
+            mgr.evaluate(now=t)
+            t += 1.0
+        assert mgr.states["sheds"].state == FIRING
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            BurnRateRule("x", key="k", budget=0.0, slo=1.0)
+        with pytest.raises(ValueError):
+            BurnRateRule("x", key="k", budget=0.1, slo=1.0,
+                         fast_s=60.0, slow_s=30.0)
+        with pytest.raises(ValueError):
+            BurnRateRule("x", key="k", budget=0.1)  # no slo, no total_key
+
+    def test_default_ruleset_shapes(self):
+        rules = default_ruleset(itl_slo_ms=50.0)
+        names = {r.name for r in rules}
+        assert {"itl_burn_rate", "shed_burn_rate", "page_arena_watermark",
+                "goodput_collapse", "recompile_storm"} <= names
+        # without an SLO there is no ITL rule to misfire on guesses
+        assert "itl_burn_rate" not in {r.name for r in default_ruleset()}
+        with pytest.raises(ValueError):
+            AlertManager(Timeline(), rules + [AlertRule("itl_burn_rate", key="x", threshold=1)])
+
+
+class TestUsageAccounting:
+    def test_page_seconds_integration_with_fake_clock(self):
+        now = [100.0]
+        u = UsageAccountant(clock=lambda: now[0])
+        u.note_pages("a", 4)          # t=100: hold 4 pages
+        now[0] = 110.0
+        u.note_pages("a", -2)         # 4 pages * 10s
+        now[0] = 115.0
+        u.advance()                   # + 2 pages * 5s
+        t = u.tenants["a"]
+        assert t.page_seconds == pytest.approx(4 * 10 + 2 * 5)
+        assert t.pages_held == 2
+        now[0] = 120.0
+        u.note_pages("a", -2)
+        u.note_pages("a", -5)         # over-release clamps, never negative
+        assert u.tenants["a"].pages_held == 0
+        now[0] = 200.0
+        u.advance()
+        assert u.tenants["a"].page_seconds == pytest.approx(
+            4 * 10 + 2 * 5 + 2 * 5
+        )
+
+    def test_totals_and_conservation_shape(self):
+        u = UsageAccountant()
+        for tenant, n in (("a", 5), ("b", 3)):
+            u.note_submit(tenant)
+            u.note_prefill(tenant, 10)
+            for _ in range(n):
+                u.note_decode(tenant)
+            u.note_outcome(tenant, "finished")
+        u.note_outcome("b", "shed")
+        totals = u.totals()
+        assert totals["decode_tokens"] == 8
+        assert totals["prefill_tokens"] == 20
+        assert totals["finished"] == 2 and totals["shed"] == 1
+
+    def test_windowed_deltas(self):
+        now = [0.0]
+        u = UsageAccountant(clock=lambda: now[0])
+        u.note_decode("a", 10)
+        u.mark()
+        now[0] = 30.0
+        u.note_decode("a", 7)
+        win = u.window(10.0)
+        assert win["a"]["decode_tokens"] == 7
+
+    def test_window_without_marks_is_zero_not_lifetime(self):
+        """timeline=False never calls mark(); the window must read as
+        empty, not as lifetime totals masquerading as a rate."""
+        u = UsageAccountant()
+        u.note_decode("a", 500)
+        win = u.window(60.0)
+        assert win["a"]["decode_tokens"] == 0
+        assert win["a"]["span_s"] == 0.0
+
+    def test_tenant_cardinality_folds_into_other(self):
+        u = UsageAccountant(max_tenants=3)
+        for i in range(10):
+            u.note_decode(f"tenant{i}")
+        assert len(u.tenants) <= 4  # 3 + _other
+        assert u.overflowed
+        assert u.tenants[OVERFLOW_TENANT].decode_tokens == 7
+        assert u.totals()["decode_tokens"] == 10  # conservation survives folding
+
+    def test_snapshot_round_trip(self, tmp_path):
+        u = UsageAccountant()
+        u.note_decode("acme", 5)
+        u.note_pages("acme", 2)
+        u.write_snapshot(str(tmp_path / "usage-host0.json"))
+        u2 = UsageAccountant()
+        u2.note_decode("acme", 3)
+        u2.write_snapshot(str(tmp_path / "usage-host1.json"))
+        merged = load_usage(str(tmp_path))
+        assert merged["tenants"]["acme"]["decode_tokens"] == 8
+        assert merged["hosts"] == 2
+
+
+class TestExporterHardening:
+    def _fake_session(self, values, alerts=None):
+        class S:
+            hists = {}
+
+            def rollup(self):
+                return values
+
+        s = S()
+        if alerts is not None:
+            class A:
+                def states_snapshot(self):
+                    return alerts
+
+            s.alerts = A()
+        return s
+
+    def test_dynamic_keys_sanitized_to_exposition_charset(self):
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+
+        text = prometheus_text(self._fake_session({
+            'serving/quota_bad tenant"💥\n_tokens_used': 5,
+            "exe/decode:v2_mfu": 1.5,
+        }))
+        for line in text.splitlines():
+            if line.startswith("#") or not line:
+                continue
+            name = line.split(" ", 1)[0].split("{", 1)[0]
+            assert all(c.isalnum() or c in "_:" for c in name), line
+        assert "att_exe_decode:v2_mfu" in text  # colons survive per the format
+
+    def test_alert_series_label_values_escaped(self):
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+
+        text = prometheus_text(self._fake_session(
+            {}, alerts={'we"ird\\rule\n': {"state": "firing"},
+                        "calm": {"state": "ok"}},
+        ))
+        assert 'att_alert_firing{rule="we\\"ird\\\\rule\\n"} 1' in text
+        assert 'att_alert_firing{rule="calm"} 0' in text
+        assert "\n" in text and '\nrule' not in text  # no raw newline inside a label
+
+    def test_cardinality_cap_warns_once_and_truncates(self, caplog):
+        from accelerate_tpu.telemetry import exporter
+
+        exporter._cardinality_warned = False
+        big = {f"dyn/tenant{i}": 1 for i in range(exporter.MAX_SERIES + 50)}
+        with caplog.at_level(logging.WARNING):
+            text = prometheus_text_lines = exporter.prometheus_text(
+                self._fake_session(big)
+            )
+            exporter.prometheus_text(self._fake_session(big))
+        gauge_lines = [ln for ln in prometheus_text_lines.splitlines()
+                       if ln and not ln.startswith("#")]
+        assert len(gauge_lines) == exporter.MAX_SERIES
+        warns = [r for r in caplog.records if "cardinality" in r.message
+                 or "cap" in r.message]
+        assert len(warns) == 1, "cardinality warning must fire exactly once"
+        exporter._cardinality_warned = False
+
+    def test_scrape_server_port_conflict_falls_back_to_ephemeral(self):
+        from accelerate_tpu.telemetry.exporter import ScrapeServer
+
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        taken = blocker.getsockname()[1]
+        try:
+            srv = ScrapeServer(self._fake_session({"x": 1.0}), port=taken)
+            try:
+                assert srv.port is not None and srv.port != taken
+                assert srv.requested_port == taken
+                import urllib.request
+
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+                ).read().decode()
+                assert "att_x 1.0" in body
+            finally:
+                srv.close()
+        finally:
+            blocker.close()
+
+    def test_scrape_server_clean_shutdown_joins_thread(self):
+        from accelerate_tpu.telemetry.exporter import ScrapeServer
+
+        srv = ScrapeServer(self._fake_session({"x": 1.0}), port=0)
+        assert srv.port is not None
+        thread = srv._thread
+        assert thread is not None and thread.is_alive()
+        srv.close()
+        assert not thread.is_alive(), (
+            "a wedged scrape thread would hold the process open"
+        )
+        assert srv.server is None
+
+
+class TestReportDiff:
+    def _bench(self, tmp_path, name, value, extra):
+        (tmp_path / name).write_text(json.dumps({
+            "n": 1, "parsed": {"metric": "decoder_train_mfu", "value": value,
+                               "extra": extra},
+        }))
+
+    def test_flags_moved_metrics_only(self, tmp_path):
+        from accelerate_tpu.commands.report import (
+            collect_diff_metrics,
+            diff_metrics,
+        )
+
+        a_dir = tmp_path / "a"
+        b_dir = tmp_path / "b"
+        a_dir.mkdir()
+        b_dir.mkdir()
+        self._bench(a_dir, "BENCH_r01.json", 50.0,
+                    {"decode_ms_per_token": 2.0, "stable": 7.0,
+                     "nested": {"tokens_per_sec": 1000}})
+        self._bench(b_dir, "BENCH_r02.json", 54.0,
+                    {"decode_ms_per_token": 1.5, "stable": 7.0,
+                     "nested": {"tokens_per_sec": 990}})
+        a, b = collect_diff_metrics(str(a_dir)), collect_diff_metrics(str(b_dir))
+        diff = diff_metrics(a, b, threshold=0.1)
+        flagged = {r["metric"] for r in diff["flagged"]}
+        assert "decode_ms_per_token" in flagged      # -25%
+        assert "stable" not in flagged
+        assert "nested.tokens_per_sec" not in flagged  # -1% is under threshold
+        assert diff["flagged"][0]["metric"] == "decode_ms_per_token"
+
+    def test_from_zero_move_flags_and_stays_valid_json(self):
+        from accelerate_tpu.commands.report import diff_metrics, format_diff
+
+        diff = diff_metrics({"shed": 0.0, "ok": 1.0},
+                            {"shed": 9.0, "ok": 1.0}, threshold=0.1)
+        assert diff["flagged"][0]["metric"] == "shed"
+        assert diff["flagged"][0]["from_zero"] is True
+        # json round-trip must be spec-valid (no bare Infinity tokens)
+        assert json.loads(json.dumps(diff))["flagged"][0]["rel_change"] is None
+        assert "from zero" in format_diff(diff, "a", "b")
+
+    def test_cli_diff_and_fail_flag(self, tmp_path, capsys):
+        import argparse
+
+        from accelerate_tpu.commands import report
+
+        a = tmp_path / "BENCH_r01.json"
+        b = tmp_path / "BENCH_r02.json"
+        a.write_text(json.dumps({"parsed": {"metric": "m", "value": 10.0,
+                                            "extra": {}}}))
+        b.write_text(json.dumps({"parsed": {"metric": "m", "value": 20.0,
+                                            "extra": {}}}))
+        args = argparse.Namespace(target=None, json=False,
+                                  diff=[str(a), str(b)], threshold=0.1,
+                                  fail=False)
+        assert report.report_command(args) == 0
+        out = capsys.readouterr().out
+        assert "m" in out and "+100.0%" in out
+        args.fail = True
+        assert report.report_command(args) == 1
+        args.threshold = 5.0  # nothing moves that much
+        assert report.report_command(args) == 0
+
+    def test_diff_telemetry_dirs(self, tmp_path):
+        """Two telemetry artifact dirs diff over goodput fractions,
+        timeline means and usage totals."""
+        from accelerate_tpu.commands.report import (
+            collect_diff_metrics,
+            diff_metrics,
+        )
+
+        for side, frac, tps in (("a", 0.8, 100.0), ("b", 0.3, 50.0)):
+            d = tmp_path / side
+            d.mkdir()
+            (d / "goodput-host0.json").write_text(json.dumps({
+                "elapsed_s": 10.0,
+                "seconds": {"compute": frac * 10, "compile": 0.0,
+                            "checkpoint": 0.0, "data_wait": 0.0,
+                            "stall": 0.0, "idle": (1 - frac) * 10},
+            }))
+            tl = Timeline()
+            for i in range(5):
+                tl.add_sample({"serving/tokens_per_s": tps}, now=float(i))
+            tl.flush_jsonl(str(d / "timeline-host0.jsonl"))
+        a = collect_diff_metrics(str(tmp_path / "a"))
+        b = collect_diff_metrics(str(tmp_path / "b"))
+        assert a["goodput/compute_frac"] == pytest.approx(0.8)
+        diff = diff_metrics(a, b, threshold=0.2)
+        flagged = {r["metric"] for r in diff["flagged"]}
+        assert "goodput/compute_frac" in flagged
+        assert "timeline/serving/tokens_per_s/mean" in flagged
+
+
+class TestWatchRendering:
+    def test_sparkline_shapes(self):
+        from accelerate_tpu.commands.watch import sparkline
+
+        assert len(sparkline([1, 2, 3], width=16)) == 16
+        assert set(sparkline([], width=4)) == {" "}
+        flat = sparkline([5.0] * 8, width=8)
+        assert len(set(flat)) == 1 and flat[0] != " "
+        ramp = sparkline(list(range(32)), width=8)
+        assert ramp[0] != ramp[-1]
+
+    def test_parse_prometheus_gauges_and_alerts(self):
+        from accelerate_tpu.commands.watch import parse_prometheus
+
+        gauges, alerts = parse_prometheus(
+            "# TYPE att_serving_tokens_per_s gauge\n"
+            "att_serving_tokens_per_s 123.5\n"
+            'att_alert_firing{rule="itl_burn_rate"} 1\n'
+            'att_alert_firing{rule="calm"} 0\n'
+            'att_serving_itl_seconds_bucket{le="0.001"} 4\n'
+        )
+        assert gauges["serving_tokens_per_s"] == 123.5
+        assert alerts == {"itl_burn_rate": 1, "calm": 0}
+        assert not any("bucket" in k for k in gauges)
+
+    def test_dir_frame_and_render(self, tmp_path):
+        from accelerate_tpu.commands.watch import load_dir_frame, render_frame
+
+        tl = Timeline()
+        for i in range(30):
+            tl.add_sample({"serving/tokens_per_s": 100.0 + i,
+                           "serving/queue_depth": float(i % 4)},
+                          now=1000.0 + i)
+        tl.flush_jsonl(str(tmp_path / "timeline-host0.jsonl"))
+        with open(tmp_path / "alerts-host0.jsonl", "w") as fh:
+            fh.write(json.dumps({"t_unix_s": 1001.0, "rule": "itl_burn_rate",
+                                 "state": "firing", "value": 9.0}) + "\n")
+        u = UsageAccountant()
+        u.note_decode("acme", 12)
+        u.write_snapshot(str(tmp_path / "usage-host0.json"))
+        frame = load_dir_frame(str(tmp_path))
+        frame["source"] = str(tmp_path)
+        text = render_frame(frame, ["serving/tokens_per_s",
+                                    "serving/queue_depth"])
+        assert "serving/tokens_per_s" in text
+        assert "ALERTS FIRING: itl_burn_rate" in text
+        assert "acme" in text
+        assert any(c in text for c in "▁▂▃▄▅▆▇█")
