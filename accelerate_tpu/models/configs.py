@@ -76,6 +76,17 @@ class DecoderConfig:
     # prefill runs against dense per-slot gather views the engine builds.
     kv_page_size: Optional[int] = None   # tokens per page, power of two
     kv_num_pages: Optional[int] = None   # physical pages in the arena
+    # KV-cache storage precision (utils/quantization.quantize_kv /
+    # dequantize_kv; serving/pages.py arena helpers): "bf16" stores K/V at
+    # the compute dtype; "int8"/"int4" store quantized payloads plus a
+    # small parallel fp32 scale arena (one symmetric scale per token per
+    # kv head — a cache write quantizes only the token it writes, so
+    # nothing ever re-quantizes and preempt/resume/prefix-hit round-trips
+    # are drift-free). Reads dequantize in-register inside the pallas
+    # decode kernels (HBM decode traffic shrinks 2-4x) or as the fused
+    # astype*scale of the masked-dense reference. Applies to both the
+    # dense slot arena and the paged arena.
+    kv_cache_dtype: str = "bf16"
     # decode-attention implementation for the KV-cache decode paths
     # (ops/attention dispatch). None -> the ATT_DECODE_KERNEL env knob
     # (default "paged": the length-aware pallas decode kernel on TPU —
@@ -160,6 +171,16 @@ class DecoderConfig:
                 raise ValueError(f"kv_page_size must be a power of two, got {ps}")
             if self.kv_num_pages < 1:
                 raise ValueError(f"kv_num_pages must be >= 1, got {self.kv_num_pages}")
+        if self.kv_cache_dtype not in ("bf16", "int8", "int4"):
+            raise ValueError(
+                "kv_cache_dtype must be 'bf16', 'int8' or 'int4', got "
+                f"{self.kv_cache_dtype!r}"
+            )
+        if self.kv_cache_dtype == "int4" and self.head_dim % 2:
+            raise ValueError(
+                f"int4 KV packing pairs head_dim values into bytes; head_dim "
+                f"must be even, got {self.head_dim}"
+            )
         if self.decode_kernel not in (None, "paged", "dense", "interpret"):
             raise ValueError(
                 "decode_kernel must be None, 'paged', 'dense' or "

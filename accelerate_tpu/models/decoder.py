@@ -172,6 +172,16 @@ class DecoderAttention(nn.Module):
     tables is copy-on-write prefix sharing; the serving engine forks
     pages before divergent writes.
 
+    ``config.kv_cache_dtype`` ("int8"/"int4") makes the cache STORAGE
+    quantized on both layouts: writes quantize the fresh K/V rows (one
+    fp32 scale per token per kv head, kept in a parallel
+    ``cached_key_scale``/``cached_value_scale`` arena) fused into the same
+    scatter, reads dequantize in-register inside the pallas decode kernels
+    or via the reference dequant on the masked-dense path. Because a
+    write only ever quantizes the values it writes, page shares, CoW
+    forks, preemption page-outs and prefix-cache hits move the quantized
+    payload + scales verbatim — nothing is ever re-quantized.
+
     ``causal=False`` (+ optional ``kv_mask``) is the bidirectional form the
     seq2seq encoder reuses (models/seq2seq.py) — same projections, RoPE and
     logical axes, no cache. Ring attention over a "sequence" mesh axis is
@@ -213,19 +223,47 @@ class DecoderAttention(nn.Module):
         k = apply_rotary_embedding(k, sin, cos)
 
         if self.use_cache:
-            # getattr: Seq2SeqConfig reuses this module and has no paging knobs
+            # getattr: Seq2SeqConfig reuses this module and has no paging
+            # (or KV-precision) knobs
             paged = getattr(cfg, "kv_page_size", None) is not None
             max_len = cfg.max_cache_len or cfg.max_seq_len
+            # quantized KV storage (config.kv_cache_dtype): payloads are
+            # int8 (int4 packs two head_dim values per byte) with a small
+            # parallel fp32 scale arena — one symmetric scale per (token,
+            # kv head), computed at the WRITE from the fresh K/V values, so
+            # no write ever re-quantizes existing cache content. Scale
+            # leaves keep the payloads' rank (trailing dim 1), so every
+            # generic cache-tree op (slot views, page gathers/scatters,
+            # CoW forks) moves payload and scale together untouched.
+            kvq_bits = {"int8": 8, "int4": 4}.get(
+                getattr(cfg, "kv_cache_dtype", "bf16"), 0
+            )
+            pd = d // 2 if kvq_bits == 4 else d
+            store_dt = jnp.int8 if kvq_bits else k.dtype
+            cached_ks = cached_vs = None
             if paged:
+                page_shape = (cfg.kv_num_pages, kv, cfg.kv_page_size)
                 cached_k = self.variable(
-                    "cache", "cached_key", jnp.zeros,
-                    (cfg.kv_num_pages, kv, cfg.kv_page_size, d), k.dtype)
+                    "cache", "cached_key", jnp.zeros, page_shape + (pd,), store_dt)
                 cached_v = self.variable(
-                    "cache", "cached_value", jnp.zeros,
-                    (cfg.kv_num_pages, kv, cfg.kv_page_size, d), v.dtype)
+                    "cache", "cached_value", jnp.zeros, page_shape + (pd,), store_dt)
+                if kvq_bits:
+                    cached_ks = self.variable(
+                        "cache", "cached_key_scale", jnp.zeros,
+                        page_shape + (1,), jnp.float32)
+                    cached_vs = self.variable(
+                        "cache", "cached_value_scale", jnp.zeros,
+                        page_shape + (1,), jnp.float32)
             else:
-                cached_k = self.variable("cache", "cached_key", jnp.zeros, (b, kv, max_len, d), k.dtype)
-                cached_v = self.variable("cache", "cached_value", jnp.zeros, (b, kv, max_len, d), v.dtype)
+                cached_k = self.variable("cache", "cached_key", jnp.zeros, (b, kv, max_len, pd), store_dt)
+                cached_v = self.variable("cache", "cached_value", jnp.zeros, (b, kv, max_len, pd), store_dt)
+                if kvq_bits:
+                    cached_ks = self.variable(
+                        "cache", "cached_key_scale", jnp.zeros,
+                        (b, kv, max_len, 1), jnp.float32)
+                    cached_vs = self.variable(
+                        "cache", "cached_value_scale", jnp.zeros,
+                        (b, kv, max_len, 1), jnp.float32)
             cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
             cur = cache_index.value
             if paged and (not self.decode or cache_positions is None or page_table is None):
@@ -237,9 +275,25 @@ class DecoderAttention(nn.Module):
                 )
             if not self.decode:
                 # prefill: cache starts at 0, so plain causal attention over
-                # the freshly computed K/V stays on the flash-kernel path
-                cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, 0, 0))
-                cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, 0, 0))
+                # the freshly computed K/V stays on the flash-kernel path.
+                # Quantized: store payload+scale and attend over the
+                # DEQUANTIZED values — the stored cache is the source of
+                # truth, so whole-prompt prefill stays token-identical to
+                # the chunked prefill path (which reads the cache back).
+                if kvq_bits:
+                    from ..utils.quantization import dequantize_kv, quantize_kv
+
+                    k_q, k_s = quantize_kv(k, kvq_bits)
+                    v_q, v_s = quantize_kv(v, kvq_bits)
+                    cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k_q, (0, 0, 0, 0))
+                    cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v_q, (0, 0, 0, 0))
+                    cached_ks.value = jax.lax.dynamic_update_slice(cached_ks.value, k_s, (0, 0, 0, 0))
+                    cached_vs.value = jax.lax.dynamic_update_slice(cached_vs.value, v_s, (0, 0, 0, 0))
+                    k = dequantize_kv(k_q, k_s, kvq_bits, q.dtype)
+                    v = dequantize_kv(v_q, v_s, kvq_bits, q.dtype)
+                else:
+                    cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, 0, 0))
+                    cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, 0, 0))
                 cache_index.value = jnp.asarray(s, jnp.int32)
                 out = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
             elif cache_positions is not None:
@@ -262,6 +316,15 @@ class DecoderAttention(nn.Module):
                 rows = jnp.arange(b)
                 kv_new = jnp.swapaxes(k, 1, 2)  # [B, S, KVH, D]
                 vv_new = jnp.swapaxes(v, 1, 2)
+                # quantize-on-write, fused into the cache scatter: only the
+                # freshly computed token rows quantize (per-row scale over
+                # D), existing cache content is never touched
+                ks_new = vs_new = None
+                if kvq_bits:
+                    from ..utils.quantization import quantize_kv
+
+                    kv_new, ks_new = quantize_kv(kv_new, kvq_bits)
+                    vv_new, vs_new = quantize_kv(vv_new, kvq_bits)
                 # decode-kernel knobs (ops/attention dispatch): the pallas
                 # length-aware kernel on TPU / under "interpret", the
                 # masked-dense reference otherwise. getattr: Seq2SeqConfig
@@ -278,10 +341,18 @@ class DecoderAttention(nn.Module):
                     v_pages = cached_v.value.at[page, :, off].set(vv_new)
                     cached_k.value = k_pages
                     cached_v.value = v_pages
+                    scale_kw = {}
+                    if kvq_bits:
+                        k_sc = cached_ks.value.at[page, :, off].set(ks_new)
+                        v_sc = cached_vs.value.at[page, :, off].set(vs_new)
+                        cached_ks.value = k_sc
+                        cached_vs.value = v_sc
+                        scale_kw = {"k_scale": k_sc, "v_scale": v_sc,
+                                    "kv_quant_bits": kvq_bits}
                     out = paged_decode_attention(
                         q, k_pages, v_pages,
                         page_table=page_table, q_positions=pos2d,
-                        impl=dk_impl,
+                        impl=dk_impl, **scale_kw,
                     )
                 else:
                     from ..ops.attention import decode_attention
@@ -290,11 +361,31 @@ class DecoderAttention(nn.Module):
                     v_full = cached_v.value.at[rows[:, None], :, pos2d].set(vv_new)
                     cached_k.value = k_full
                     cached_v.value = v_full
+                    scale_kw = {}
+                    if kvq_bits:
+                        k_sc = cached_ks.value.at[rows[:, None], :, pos2d].set(ks_new)
+                        v_sc = cached_vs.value.at[rows[:, None], :, pos2d].set(vs_new)
+                        cached_ks.value = k_sc
+                        cached_vs.value = v_sc
+                        scale_kw = {"k_scale": k_sc, "v_scale": v_sc,
+                                    "kv_quant_bits": kvq_bits}
                     out = decode_attention(
                         q, k_full, v_full, q_positions=pos2d,
-                        impl=dk_impl, block_kv=dk_blk,
+                        impl=dk_impl, block_kv=dk_blk, **scale_kw,
                     )
             else:
+                scale_kw = {}
+                if kvq_bits:
+                    from ..utils.quantization import quantize_kv
+
+                    k, k_s = quantize_kv(k, kvq_bits)
+                    v, v_s = quantize_kv(v, kvq_bits)
+                    k_sc = jax.lax.dynamic_update_slice(cached_ks.value, k_s, (0, 0, cur, 0))
+                    v_sc = jax.lax.dynamic_update_slice(cached_vs.value, v_s, (0, 0, cur, 0))
+                    cached_ks.value = k_sc
+                    cached_vs.value = v_sc
+                    scale_kw = {"k_scale": k_sc, "v_scale": v_sc,
+                                "kv_quant_bits": kvq_bits}
                 k_full = jax.lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
                 v_full = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
                 cached_k.value = k_full
@@ -315,6 +406,7 @@ class DecoderAttention(nn.Module):
                     q, k_full, v_full, q_positions=cur + jnp.arange(s),
                     impl=getattr(cfg, "decode_kernel", None) if s == 1 else "dense",
                     block_kv=getattr(cfg, "decode_kernel_block", None),
+                    **scale_kw,
                 )
         elif (
             self.causal

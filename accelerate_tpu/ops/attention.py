@@ -29,6 +29,12 @@ Capabilities:
   scales with live tokens, not arena capacity. Masked-dense stays the
   fallback + bit-exactness reference (`ATT_DECODE_KERNEL=paged|dense`,
   "interpret" for CPU tests)
+- quantized KV arenas (`kv_quant_bits=8|4` + per-token scale operands):
+  both decode kernels read int8/packed-int4 payloads from HBM and
+  dequantize in-register before the flash inner product, so the byte
+  shrink compounds with the live-token walk; the masked-dense fallback
+  dequantizes via the same reference op sequence
+  (utils/quantization.dequantize_kv) and stays the exactness oracle
 """
 
 from __future__ import annotations
@@ -706,10 +712,16 @@ def _warn_decode_fallback(reason: str):
     )
 
 
-def _decode_kernel_gate(mode: str, sq: int, d: int, blk: int):
+def _decode_kernel_gate(mode: str, sq: int, d: int, blk: int,
+                        quant_bits: int = 0):
     """(use_kernel, interpret) for one dispatch. Falls back silently for
     by-design exclusions (``dense`` mode, prefill-size Sq) and with a
-    warn-once for environment/shape gates."""
+    warn-once for environment/shape gates. ``quant_bits`` extends the
+    compiled-mode shape rule to the operands the quantized kernel
+    actually loads: int4's packed payload blocks are ``d // 2`` wide, so
+    the 128-multiple rule applies to THAT width (head_dim must be a
+    256-multiple compiled) — without this, an unsupported tiling would
+    surface as a Mosaic compile error instead of the dense fallback."""
     if mode == "dense":
         return False, False
     if sq > _DECODE_KERNEL_MAX_SQ:
@@ -731,6 +743,14 @@ def _decode_kernel_gate(mode: str, sq: int, d: int, blk: int):
             f"block/page size {blk} an 8-multiple for the compiled kernel"
         )
         return False, False
+    if quant_bits == 4 and (d // 2) % 128 != 0:
+        _warn_decode_fallback(
+            f"shape gate: int4 KV packs the payload to head_dim/2 = "
+            f"{d // 2}, which must itself be a 128-multiple for the "
+            "compiled kernel (head_dim a 256-multiple); this dispatch "
+            "runs the gathered dequant + masked-dense read"
+        )
+        return False, False
     return True, False
 
 
@@ -749,7 +769,10 @@ def decode_kernel_active(config, sq: int = 1) -> bool:
     if mode == "dense":
         return False
     head_dim = int(getattr(config, "head_dim", 0) or 0)
-    use, _ = _decode_kernel_gate(mode, sq, head_dim, int(page_size))
+    quant_bits = {"int8": 8, "int4": 4}.get(
+        getattr(config, "kv_cache_dtype", "bf16"), 0
+    )
+    use, _ = _decode_kernel_gate(mode, sq, head_dim, int(page_size), quant_bits)
     return use
 
 
@@ -770,7 +793,9 @@ def _pick_decode_block(length: int, preferred: Optional[int], interpret: bool) -
 
 
 def _decode_kernel_body(maxblk_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                        acc, m_scr, l_scr, *, sm_scale, bk, sq, group):
+                        acc, m_scr, l_scr, *, sm_scale, bk, sq, group,
+                        quant_bits=0, out_dtype=None,
+                        ks_ref=None, vs_ref=None):
     """Online-softmax accumulation over one slot's kv blocks — shared by
     the paged and dense-arena variants (only the BlockSpec index maps
     differ). Grid is (B, KVH, n_blocks) with the block dim innermost
@@ -779,7 +804,15 @@ def _decode_kernel_body(maxblk_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
     clamped index map. Per-element validity is ``kv position <= the query
     row's position``, the exact mask of the dense reference, so parked /
     stale / rolled-back entries inside a live block contribute exactly
-    zero probability."""
+    zero probability.
+
+    ``quant_bits`` (8/4) turns on KERNEL-FUSED DEQUANT: ``k_ref``/``v_ref``
+    hold int8 payloads (int4 packs two values per byte along head_dim) and
+    ``ks_ref``/``vs_ref`` the per-(token, kv-head) fp32 scales; blocks load
+    quantized from HBM — the byte shrink compounds with the live-token walk
+    — and dequantize in-register via ``utils.quantization.dequantize_kv``,
+    the same op sequence the masked-dense reference runs, so the oracle
+    contract survives quantization."""
     b, ib = pl.program_id(0), pl.program_id(2)
     nb = pl.num_programs(2)
     g = group * sq
@@ -793,8 +826,13 @@ def _decode_kernel_body(maxblk_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(ib <= maxblk_ref[b])
     def _body():
         q = q_ref[0, 0]  # [G, D] — the kv head's query group × Sq rows
-        k = k_ref[0, 0]  # [bk, D]
+        k = k_ref[0, 0]  # [bk, D] (quantized: int8 payload [bk, D or D/2])
         v = v_ref[0, 0]
+        if quant_bits:
+            from ..utils.quantization import dequantize_kv
+
+            k = dequantize_kv(k, ks_ref[0, 0], quant_bits, out_dtype)
+            v = dequantize_kv(v, vs_ref[0, 0], quant_bits, out_dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
@@ -839,6 +877,21 @@ def _paged_kernel_entry(maxblk_ref, pos_ref, table_ref, q_ref, k_ref, v_ref,
                         acc, m_scr, l_scr, **kw)
 
 
+def _paged_quant_kernel_entry(maxblk_ref, pos_ref, table_ref, q_ref, k_ref,
+                              v_ref, ks_ref, vs_ref, o_ref, acc, m_scr,
+                              l_scr, **kw):
+    # quantized arena: two extra scale operands ride the same clamped
+    # page-table index maps as their payloads
+    _decode_kernel_body(maxblk_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                        acc, m_scr, l_scr, ks_ref=ks_ref, vs_ref=vs_ref, **kw)
+
+
+def _dense_quant_kernel_entry(maxblk_ref, pos_ref, q_ref, k_ref, v_ref,
+                              ks_ref, vs_ref, o_ref, acc, m_scr, l_scr, **kw):
+    _decode_kernel_body(maxblk_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                        acc, m_scr, l_scr, ks_ref=ks_ref, vs_ref=vs_ref, **kw)
+
+
 def _decode_grid_params(interpret: bool):
     # the decode grid is 3-dim (slots, kv-heads, kv-blocks); only the
     # block walk is sequential
@@ -862,9 +915,10 @@ def _positions_2d(q_positions, b):
 
 
 def _paged_decode_kernel_call(q, k_pages, v_pages, page_table, pos,
-                              sm_scale, interpret):
+                              sm_scale, interpret, k_scale=None,
+                              v_scale=None, quant_bits=0):
     b, h, sq, d = q.shape
-    _, kvh, ps, _ = k_pages.shape
+    _, kvh, ps, pd = k_pages.shape  # pd: payload width (d, or d/2 packed int4)
     group = h // kvh
     g = group * sq
     n_blocks = page_table.shape[1]
@@ -872,23 +926,33 @@ def _paged_decode_kernel_call(q, k_pages, v_pages, page_table, pos,
     # last live BLOCK per slot: index maps clamp here so dead grid steps
     # re-address the same page (fetch elided), pl.when skips their compute
     maxblk = (jnp.max(pos, axis=1) // ps).astype(jnp.int32)
+    entry = _paged_quant_kernel_entry if quant_bits else _paged_kernel_entry
     kernel = functools.partial(
-        _paged_kernel_entry, sm_scale=sm_scale, bk=ps, sq=sq, group=group
+        entry, sm_scale=sm_scale, bk=ps, sq=sq, group=group,
+        quant_bits=quant_bits, out_dtype=q.dtype,
     )
+
+    def _page_spec(width):
+        return pl.BlockSpec(
+            (1, 1, ps, width),
+            lambda b_, h_, ib, mb, po, tb: (tb[b_, jnp.minimum(ib, mb[b_])], h_, 0, 0),
+        )
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d), lambda b_, h_, ib, mb, po, tb: (b_, h_, 0, 0)),
+        _page_spec(pd),
+        _page_spec(pd),
+    ]
+    operands = [q_r, k_pages, v_pages]
+    if quant_bits:
+        # per-(page, kv-head, token) fp32 scales ride the same clamped
+        # table walk as their payload pages
+        in_specs += [_page_spec(1), _page_spec(1)]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, kvh, n_blocks),
-        in_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda b_, h_, ib, mb, po, tb: (b_, h_, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, ps, d),
-                lambda b_, h_, ib, mb, po, tb: (tb[b_, jnp.minimum(ib, mb[b_])], h_, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, ps, d),
-                lambda b_, h_, ib, mb, po, tb: (tb[b_, jnp.minimum(ib, mb[b_])], h_, 0, 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, g, d), lambda b_, h_, ib, mb, po, tb: (b_, h_, 0, 0)),
         scratch_shapes=[_vmem((g, d)), _vmem((g, 128)), _vmem((g, 128))],
     )
@@ -897,34 +961,43 @@ def _paged_decode_kernel_call(q, k_pages, v_pages, page_table, pos,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
         **_decode_grid_params(interpret),
-    )(maxblk, pos, page_table.astype(jnp.int32), q_r, k_pages, v_pages)
+    )(maxblk, pos, page_table.astype(jnp.int32), *operands)
     return out.reshape(b, h, sq, d)
 
 
-def _dense_decode_kernel_call(q, k, v, pos, sm_scale, bk, interpret):
+def _dense_decode_kernel_call(q, k, v, pos, sm_scale, bk, interpret,
+                              k_scale=None, v_scale=None, quant_bits=0):
     b, h, sq, d = q.shape
-    kvh, length = k.shape[1], k.shape[2]
+    kvh, length, pd = k.shape[1], k.shape[2], k.shape[3]
     group = h // kvh
     g = group * sq
     q_r = _fold_q_heads(q, kvh)
     maxblk = (jnp.max(pos, axis=1) // bk).astype(jnp.int32)
+    entry = _dense_quant_kernel_entry if quant_bits else _decode_kernel_body
     kernel = functools.partial(
-        _decode_kernel_body, sm_scale=sm_scale, bk=bk, sq=sq, group=group
+        entry, sm_scale=sm_scale, bk=bk, sq=sq, group=group,
+        quant_bits=quant_bits, out_dtype=q.dtype,
     )
+
+    def _kv_spec(width):
+        return pl.BlockSpec(
+            (1, 1, bk, width),
+            lambda b_, h_, ib, mb, po: (b_, h_, jnp.minimum(ib, mb[b_]), 0),
+        )
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d), lambda b_, h_, ib, mb, po: (b_, h_, 0, 0)),
+        _kv_spec(pd),
+        _kv_spec(pd),
+    ]
+    operands = [q_r, k, v]
+    if quant_bits:
+        in_specs += [_kv_spec(1), _kv_spec(1)]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kvh, length // bk),
-        in_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda b_, h_, ib, mb, po: (b_, h_, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, bk, d),
-                lambda b_, h_, ib, mb, po: (b_, h_, jnp.minimum(ib, mb[b_]), 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, bk, d),
-                lambda b_, h_, ib, mb, po: (b_, h_, jnp.minimum(ib, mb[b_]), 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, g, d), lambda b_, h_, ib, mb, po: (b_, h_, 0, 0)),
         scratch_shapes=[_vmem((g, d)), _vmem((g, 128)), _vmem((g, 128))],
     )
@@ -933,7 +1006,7 @@ def _dense_decode_kernel_call(q, k, v, pos, sm_scale, bk, interpret):
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
         **_decode_grid_params(interpret),
-    )(maxblk, pos, q_r, k, v)
+    )(maxblk, pos, *operands)
     return out.reshape(b, h, sq, d)
 
 
@@ -946,6 +1019,9 @@ def decode_attention(
     sm_scale: Optional[float] = None,
     impl: Optional[str] = None,
     block_kv: Optional[int] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    kv_quant_bits: int = 0,
 ) -> jax.Array:
     """Masked KV-cache decode attention with per-row validity.
 
@@ -968,9 +1044,17 @@ def decode_attention(
     chunks and the ``dense`` mode run the masked-dense XLA path, which
     stays the bit-exactness reference. ``block_kv`` tunes the kernel's kv
     block (must divide L; default: largest of 512..16 that does).
+
+    ``kv_quant_bits`` (8/4, with ``k_scale``/``v_scale`` [B, KVH, L, 1]
+    fp32): k/v hold int8 payloads (int4 packed two-per-byte along D) — the
+    kernel path dequantizes IN-REGISTER after the quantized HBM read; the
+    masked-dense path runs the reference ``dequantize_kv`` first and stays
+    the exactness oracle.
     """
     mode = resolve_decode_kernel(impl)
     sq, d = q.shape[2], q.shape[3]
+    if kv_quant_bits and (k_scale is None or v_scale is None):
+        raise ValueError("kv_quant_bits needs k_scale and v_scale")
     if mode != "dense":
         bk = _pick_decode_block(k.shape[2], block_kv, mode == "interpret")
         if block_kv and bk and bk != int(block_kv):
@@ -981,11 +1065,19 @@ def decode_attention(
                 "instead — pick a divisor to make the knob effective.",
                 block_kv, k.shape[2], bk,
             )
-        use, interpret = _decode_kernel_gate(mode, sq, d, bk)
+        use, interpret = _decode_kernel_gate(mode, sq, d, bk, kv_quant_bits)
         if use:
             scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
             pos = _positions_2d(q_positions, q.shape[0])
-            return _dense_decode_kernel_call(q, k, v, pos, scale, bk, interpret)
+            return _dense_decode_kernel_call(
+                q, k, v, pos, scale, bk, interpret,
+                k_scale=k_scale, v_scale=v_scale, quant_bits=kv_quant_bits,
+            )
+    if kv_quant_bits:
+        from ..utils.quantization import dequantize_kv
+
+        k = dequantize_kv(k, k_scale, kv_quant_bits, q.dtype)
+        v = dequantize_kv(v, v_scale, kv_quant_bits, q.dtype)
     kv_pos = jnp.arange(k.shape[2])
     if q_positions.ndim == 1:  # [Sq] shared positions
         bias = jnp.where(kv_pos[None, :] <= q_positions[:, None], 0.0, NEG_INF)
@@ -1023,6 +1115,9 @@ def paged_decode_attention(
     q_positions: jax.Array,
     sm_scale: Optional[float] = None,
     impl: Optional[str] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    kv_quant_bits: int = 0,
 ) -> jax.Array:
     """Decode attention reading K/V through a per-slot page table.
 
@@ -1039,19 +1134,40 @@ def paged_decode_attention(
     exactly :func:`decode_attention`'s masked-dense path: the CPU-sim
     fallback and the bit-exactness reference the kernel is asserted
     against (tests/test_decode_kernel.py).
+
+    ``kv_quant_bits`` (8/4, with ``k_scale``/``v_scale``
+    [num_pages, KVH, page_size, 1] fp32 — a small parallel scales arena
+    beside the pages): the pages hold int8 payloads and the kernel
+    dequantizes in-register after the quantized HBM read, so the
+    live-token bandwidth win compounds with the 2-4x byte shrink. The
+    gather fallback dequantizes with the reference ``dequantize_kv`` —
+    identical quantized inputs produce the oracle's exact values.
     """
     mode = resolve_decode_kernel(impl)
+    if kv_quant_bits and (k_scale is None or v_scale is None):
+        raise ValueError("kv_quant_bits needs k_scale and v_scale")
     if mode != "dense":
         sq, d = q.shape[2], q.shape[3]
-        use, interpret = _decode_kernel_gate(mode, sq, d, k_pages.shape[2])
+        use, interpret = _decode_kernel_gate(
+            mode, sq, d, k_pages.shape[2], kv_quant_bits
+        )
         if use:
             scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
             pos = _positions_2d(q_positions, q.shape[0])
             return _paged_decode_kernel_call(
-                q, k_pages, v_pages, page_table, pos, scale, interpret
+                q, k_pages, v_pages, page_table, pos, scale, interpret,
+                k_scale=k_scale, v_scale=v_scale, quant_bits=kv_quant_bits,
             )
     k_full = gather_kv_pages(k_pages, page_table)
     v_full = gather_kv_pages(v_pages, page_table)
+    if kv_quant_bits:
+        return decode_attention(
+            q, k_full, v_full, q_positions=q_positions, sm_scale=sm_scale,
+            impl="dense",
+            k_scale=gather_kv_pages(k_scale, page_table),
+            v_scale=gather_kv_pages(v_scale, page_table),
+            kv_quant_bits=kv_quant_bits,
+        )
     return decode_attention(
         q, k_full, v_full, q_positions=q_positions, sm_scale=sm_scale, impl="dense"
     )

@@ -18,6 +18,9 @@ _EXPORTS = {
     "NGramDrafter": "pages",
     "PageAllocator": "pages",
     "PrefixCache": "pages",
+    "kv_cache_bits": "pages",
+    "kv_token_bytes": "pages",
+    "kv_quant_drift": "drift",
     # the policy tier (scheduler.py) and the fault harness (faults.py)
     # are jax-free like pages — a router tier imports them directly
     "MultiTenantScheduler": "scheduler",

@@ -52,6 +52,7 @@ from .pages import (
     dense_slot_view,
     fork_page,
     init_paged_arena,
+    kv_cache_bits,
     scatter_slot_view,
     set_table_entry,
     set_table_row,
@@ -161,6 +162,17 @@ class ServingEngine:
     beyond the frontier, where the decode mask already hides them). Spec
     reserves ``spec_draft_len`` tokens of per-slot KV headroom.
 
+    ``kv_cache_dtype`` ("int8"/"int4"; default: the config's, else bf16)
+    stores the KV arena quantized — int8/packed-int4 payloads plus a
+    per-(token, kv-head) fp32 scale arena that rides every page op
+    (fork/share/page-out) beside its payload. Writes quantize only the
+    fresh rows (fused into the cache scatter), reads dequantize inside the
+    pallas decode kernel (or the masked-dense reference), so 2-4x more
+    concurrent slots fit the same KV HBM budget at an accuracy cost the
+    drift harness (``serving.drift``) quantifies. Compile set and the
+    zero-recompile invariant are unchanged — quantization is a cache-leaf
+    dtype, not a program shape.
+
     The decode step and every prefill-chunk bucket compile exactly once;
     after ``mark_steady()`` the ``admission_recompiles`` property must
     stay 0 no matter what traffic arrives — admissions, prefix hits, page
@@ -191,6 +203,7 @@ class ServingEngine:
         drafter=None,
         scheduler=None,
         faults=None,
+        kv_cache_dtype: Optional[str] = None,
     ):
         from ..utils.compile_cache import (
             compile_event_counters,
@@ -207,6 +220,19 @@ class ServingEngine:
                 "ServingEngine needs a definition with a DecoderConfig-style "
                 "config (max_cache_len/max_seq_len)"
             )
+        # KV-cache storage precision: the engine knob wins, else whatever
+        # the config already carries. Cloning the definition here (before
+        # cache sizing) makes every program this engine compiles — prefill
+        # buckets against slot views, the fused decode step, spec verify —
+        # create/consume the quantized payload + scale cache leaves.
+        kvq = kv_cache_dtype or getattr(cfg, "kv_cache_dtype", "bf16") or "bf16"
+        kv_cache_bits(kvq)  # validate early (raises on typos)
+        self.kv_cache_dtype = kvq
+        if kvq != getattr(cfg, "kv_cache_dtype", "bf16"):
+            definition = definition.clone(
+                config=dataclasses.replace(cfg, kv_cache_dtype=kvq)
+            )
+            cfg = definition.config
         cap = max_cache_len or cfg.max_cache_len or cfg.max_seq_len
         if cap != cfg.max_cache_len:
             definition = _sized_definition(definition, cap)
@@ -1856,6 +1882,10 @@ class ServingEngine:
             "serving/requests_completed": self.requests_completed,
             "serving/generated_tokens": self.generated_tokens,
             "serving/arena_bytes": self.arena_bytes,
+            # storage bits per K/V value (16 = unquantized) — the capacity
+            # dashboards read this beside arena_bytes/pages_total to tell
+            # a quantized arena from a shrunk one
+            "serving/kv_cache_bits": kv_cache_bits(self.kv_cache_dtype),
         }
         if (
             self._sched is not None

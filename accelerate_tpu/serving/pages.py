@@ -43,6 +43,54 @@ from typing import Optional
 import numpy as np
 
 
+# -- quantized-arena host helpers (jax-free, like everything above the
+# device section: a router/admission tier sizes KV budgets on machines
+# with no accelerator stack — locked by tests/test_imports.py) ------------
+
+KV_CACHE_DTYPES = ("bf16", "int8", "int4")
+
+
+def kv_cache_bits(kv_dtype) -> int:
+    """Storage bits per K/V value for a ``kv_cache_dtype`` knob value
+    (None/"bf16" -> 16). The host twin of
+    ``utils.quantization.kv_cache_bits`` (which lives jax-side)."""
+    if kv_dtype in (None, "bf16"):
+        return 16
+    if kv_dtype == "int8":
+        return 8
+    if kv_dtype == "int4":
+        return 4
+    raise ValueError(
+        f"kv_cache_dtype must be one of {KV_CACHE_DTYPES}, got {kv_dtype!r}"
+    )
+
+
+def kv_payload_width(head_dim: int, kv_dtype) -> int:
+    """Trailing payload dim of a K/V cache leaf: head_dim, or head_dim/2
+    when int4 packs two values per byte."""
+    if kv_cache_bits(kv_dtype) == 4:
+        if head_dim % 2:
+            raise ValueError(f"int4 KV needs an even head_dim, got {head_dim}")
+        return head_dim // 2
+    return head_dim
+
+
+def kv_token_bytes(num_kv_heads: int, head_dim: int, kv_dtype,
+                   cache_itemsize: int = 2, num_layers: int = 1) -> int:
+    """HBM bytes one cached token costs across K and V (payload + the
+    fp32 scale the quantized arena carries per (token, kv head)) — the
+    capacity-planning number behind ``arena_hbm_bytes_per_slot`` and the
+    ≥2x-slots math. ``cache_itemsize`` is the unquantized cache dtype's
+    byte width (bf16 -> 2)."""
+    bits = kv_cache_bits(kv_dtype)
+    if bits == 16:
+        per_value = num_kv_heads * head_dim * cache_itemsize
+        return 2 * num_layers * per_value
+    payload = num_kv_heads * kv_payload_width(head_dim, kv_dtype)
+    scale = num_kv_heads * 4  # one fp32 per (token, kv head)
+    return 2 * num_layers * (payload + scale)
+
+
 def _digest(tokens: np.ndarray) -> bytes:
     """Stable content key for a token prefix (dtype-normalized so the same
     ids hash equally regardless of the caller's integer width)."""
@@ -299,7 +347,12 @@ class PagedTables:
 # device helpers (lazy jax: the bookkeeping above must import accelerator-free)
 # ---------------------------------------------------------------------------
 
-_KV_NDIM = 4  # paged K/V leaves are [num_pages, KVH, page_size, D] (+ layer axis)
+# paged K/V leaves are [num_pages, KVH, page_size, D] (+ layer axis). A
+# quantized arena's scale leaves are [num_pages, KVH, page_size, 1] — same
+# rank BY DESIGN, so every generic tree op below (gather views, scatters,
+# CoW forks) moves a page's payload and its scales together with no
+# special-casing, and nothing can fork or share one without the other.
+_KV_NDIM = 4
 
 
 def _is_kv(leaf) -> bool:

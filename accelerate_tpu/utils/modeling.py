@@ -460,10 +460,18 @@ def _stream_device_leaves(device_paths, flat_loaded, shardings, dtype,
 
       reader thread     materializes checkpoint bytes (memmap page-in /
                         pread) + applies the dtype cast, one leaf ahead of
-                        the quantizer, under the read-ahead byte gate
-      quantize thread   packs eligible leaves int8/int4 via the native csrc
-                        kernel (the ctypes call releases the GIL, so it
-                        really runs beside the reader and the AOT thread)
+                        the quantizers, under the read-ahead byte gate
+      quantize pool     packs eligible leaves int8/int4 via the native csrc
+                        kernel (the ctypes call releases the GIL, so the
+                        ``ATT_DISPATCH_QUANT_THREADS`` workers — default
+                        min(4, cores) — really pack in parallel beside the
+                        reader and the AOT thread; the round-5 phases
+                        showed host_quantize fully serial at 2.9 s on ONE
+                        thread while the kernel's pool sat idle). Workers
+                        tag results with the reader's sequence number and
+                        the caller reorders, so leaf submit order — and
+                        therefore the ~64MB chunk grouping and every byte
+                        placed — is identical to the serial path
       caller thread     groups results into ~64MB chunks and submits
                         batched async jax.device_put calls — the previous
                         chunk's h2d transfer is in flight while the next
@@ -626,14 +634,14 @@ def _stream_device_leaves(device_paths, flat_loaded, shardings, dtype,
 
     def _reader():
         try:
-            for path in device_paths:
+            for seq, path in enumerate(device_paths):
                 nbytes = _leaf_nbytes(path)
                 gate.acquire(nbytes)
                 if stop.is_set():
                     gate.release(nbytes)
                     return
                 value = _read_one(path)
-                if not _put(q_read, (path, value, nbytes)):
+                if not _put(q_read, (seq, path, value, nbytes)):
                     gate.release(nbytes)
                     return
         except BaseException as e:  # propagate into the caller thread
@@ -641,14 +649,35 @@ def _stream_device_leaves(device_paths, flat_loaded, shardings, dtype,
         finally:
             _put(q_read, None)  # skipped when stopping: shutdown wakes consumers
 
+    # quantize worker pool: the csrc pack kernel releases the GIL, so
+    # several leaves really pack concurrently. One worker when nothing
+    # quantizes (pass-through entries need no parallelism). Each worker
+    # forwards the upstream None so its siblings also drain, then posts
+    # its own completion sentinel to the caller.
+    if quantization_config is not None:
+        # int() BEFORE the fallback: an unset/empty/"0" knob means "use
+        # the default pool", and "0" is a truthy *string*
+        n_quant = int(os.environ.get("ATT_DISPATCH_QUANT_THREADS") or 0)
+        n_quant = max(1, n_quant or min(4, os.cpu_count() or 1))
+    else:
+        n_quant = 1
+
     def _quantizer():
         try:
             while True:
                 item = q_read.get()
                 if item is None:
+                    # wake the next worker. Non-blocking on purpose: after
+                    # a shutdown drain `_put` would refuse (stop is set)
+                    # and strand a sibling on get(); the drained queue
+                    # always has room for the sentinel.
+                    try:
+                        q_read.put_nowait(None)
+                    except queue.Full:
+                        pass
                     break
-                path, value, nbytes = item
-                if not _put(q_quant, (_quantize_one(path, value), nbytes)):
+                seq, path, value, nbytes = item
+                if not _put(q_quant, (seq, _quantize_one(path, value), nbytes)):
                     return
         except BaseException as e:
             errors.append(e)
@@ -657,18 +686,33 @@ def _stream_device_leaves(device_paths, flat_loaded, shardings, dtype,
 
     threads = [
         threading.Thread(target=_reader, name="att-dispatch-read", daemon=True),
-        threading.Thread(target=_quantizer, name="att-dispatch-quantize", daemon=True),
+    ] + [
+        threading.Thread(target=_quantizer, name=f"att-dispatch-quantize-{i}",
+                         daemon=True)
+        for i in range(n_quant)
     ]
     for t in threads:
         t.start()
     try:
-        while True:
+        # reorder buffer: workers finish out of order, but the submit
+        # order (and so the chunk grouping and the transfer stream) must
+        # be byte-identical to the serial path
+        buf: dict = {}
+        next_seq = 0
+        workers_done = 0
+        while workers_done < n_quant:
             item = q_quant.get()
             if item is None:
-                break
-            entry, nbytes = item
-            _submit_one(entry, nbytes)
+                workers_done += 1
+                continue
+            seq, entry, nbytes = item
+            buf[seq] = (entry, nbytes)
+            while next_seq in buf:
+                entry, nbytes = buf.pop(next_seq)
+                _submit_one(entry, nbytes)
+                next_seq += 1
         if not errors:
+            assert not buf, f"dispatch pipeline dropped leaves {sorted(buf)}"
             with phase("transfer_submit"):
                 _flush_pending()
     finally:

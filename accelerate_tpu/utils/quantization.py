@@ -434,6 +434,85 @@ def dequantize_params(params):
     )
 
 
+# ---------------------------------------------------------------------------
+# KV-cache quantization (the serving arena's int8/int4 storage)
+#
+# Unlike the weight path above — a load-time host transform — KV quantization
+# is IN-GRAPH: the decode step quantizes each freshly computed K/V token as it
+# scatters into the cache (models/decoder.py), and the read side dequantizes
+# either inside the pallas decode kernel (ops/attention.py, in-register) or as
+# the fused ``payload.astype(f32) * scale`` the masked-dense reference runs.
+# Scales are symmetric per (token, kv-head): one fp32 amax scale over the
+# head_dim values a single cache write produces, so a write never has to
+# re-quantize existing cache content (no double-quantization drift) and a page
+# carries its scales beside it through CoW forks, prefix-cache shares, and
+# preemption page-outs. int4 packs two values per byte along head_dim.
+# ---------------------------------------------------------------------------
+
+KV_CACHE_DTYPES = ("bf16", "int8", "int4")
+
+
+def kv_cache_bits(kv_dtype) -> int:
+    """Storage bits per K/V value for a ``kv_cache_dtype`` knob value
+    (None/"bf16" -> 16). Raises on unknown dtypes so a typo'd config cannot
+    silently serve full-precision."""
+    if kv_dtype in (None, "bf16"):
+        return 16
+    if kv_dtype == "int8":
+        return 8
+    if kv_dtype == "int4":
+        return 4
+    raise ValueError(
+        f"kv_cache_dtype must be one of {KV_CACHE_DTYPES}, got {kv_dtype!r}"
+    )
+
+
+def quantize_kv(x, bits: int):
+    """In-graph symmetric quantization of fresh K/V values along the LAST
+    axis (head_dim): ``x [..., D]`` -> ``(payload int8 [..., D] (int8) or
+    [..., D//2] (int4, two nibbles per byte), scale fp32 [..., 1])`` with
+    ``x ~= payload * scale``. Zero rows quantize to payload 0 / scale 1.0
+    (exact round trip). Traced-friendly: this runs inside the jitted decode
+    step / prefill chunk programs."""
+    if bits not in (8, 4):
+        raise ValueError(f"KV quantization supports 8 or 4 bits, got {bits}")
+    qmax = float(2 ** (bits - 1) - 1)
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    q = jnp.clip(jnp.round(x32 * (1.0 / scale)), -qmax, qmax).astype(jnp.int8)
+    if bits == 4:
+        if x.shape[-1] % 2:
+            raise ValueError(
+                f"int4 KV packing needs an even head_dim, got {x.shape[-1]}"
+            )
+        lo = q[..., 0::2] & 0x0F
+        hi = (q[..., 1::2] & 0x0F) << 4
+        q = (lo | hi).astype(jnp.int8)
+    return q, scale
+
+
+def unpack_int4_kv(payload):
+    """[..., D//2] packed nibbles -> [..., D] signed int8 values (even
+    head_dim indices in the low nibble, odd in the high — the inverse of
+    :func:`quantize_kv`'s interleave)."""
+    lo = (payload << 4).astype(jnp.int8) >> 4          # sign-extend low nibble
+    hi = payload >> 4                                   # arithmetic shift
+    out = jnp.stack([lo, hi], axis=-1)
+    return out.reshape(*payload.shape[:-1], 2 * payload.shape[-1])
+
+
+def dequantize_kv(payload, scale, bits: int, dtype):
+    """Reference dequant — the EXACT op sequence the pallas decode kernels
+    run in-register (``values.astype(f32) * scale`` then a cast to the
+    compute dtype), so the gathered masked-dense fallback stays the
+    bit-exactness oracle for the fused kernel path on identical quantized
+    inputs."""
+    if bits == 4:
+        payload = unpack_int4_kv(payload)
+    return (payload.astype(jnp.float32) * scale).astype(dtype)
+
+
 def quantized_nbytes(params) -> int:
     """Device bytes of a (possibly quantized) tree — for map/memory math."""
     total = 0

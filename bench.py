@@ -36,6 +36,7 @@ Measurement notes (the TPU here is tunnel-attached):
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -236,11 +237,15 @@ def _train_goodput_bench(cfg, batch_size, seq_len, steps, mixed_precision,
 
 
 def _publish_goodput_rows(extra, cfg, batch_size, seq_len, steps,
-                          mixed_precision, telemetry_out, untraced_tok_s):
+                          mixed_precision, telemetry_out, untraced_tok_s,
+                          prefix="train_"):
     """Run the traced wave and publish its rows. With ``--telemetry-out``
     the artifact dir (goodput/costs/forensics JSON) persists next to the
     metrics JSONL for `accelerate-tpu report`; otherwise a tempdir is
-    used and discarded after the rollup is read."""
+    used and discarded after the rollup is read. ``prefix`` names the
+    row family — the fp8 forensics pass reuses this wave verbatim under
+    ``fp8_train_*`` (ROADMAP 5b: the same recompile-forensics +
+    per-executable-roofline instrumentation, pointed at the fp8 step)."""
     import tempfile
 
     if telemetry_out:
@@ -254,13 +259,13 @@ def _publish_goodput_rows(extra, cfg, batch_size, seq_len, steps,
     finally:
         if ctx is not None:
             ctx.cleanup()
-    extra["train_goodput_frac"] = gp["goodput_frac"]
-    extra["train_step_mfu_model"] = gp["mfu_model_pct"]
-    extra["train_telemetry_overhead_pct"] = gp["overhead_pct"]
-    extra["train_recompiles_diagnosed"] = gp["recompiles_diagnosed"]
-    extra["train_timeline_samples"] = gp["timeline_samples"]
-    extra["train_alert_rules"] = gp["alert_rules"]
-    extra["train_alerts_firing"] = gp["alerts_firing"]
+    extra[f"{prefix}goodput_frac"] = gp["goodput_frac"]
+    extra[f"{prefix}step_mfu_model"] = gp["mfu_model_pct"]
+    extra[f"{prefix}telemetry_overhead_pct"] = gp["overhead_pct"]
+    extra[f"{prefix}recompiles_diagnosed"] = gp["recompiles_diagnosed"]
+    extra[f"{prefix}timeline_samples"] = gp["timeline_samples"]
+    extra[f"{prefix}alert_rules"] = gp["alert_rules"]
+    extra[f"{prefix}alerts_firing"] = gp["alerts_firing"]
 
 
 def _encoder_bench(batch_size, seq_len, steps):
@@ -1119,6 +1124,192 @@ def _serving_ragged_bench(cfg, prompt_len, *, num_slots=8, page_size=16,
     return out
 
 
+def _serving_kv_quant_bench(cfg, prompt_len, *, page_size=16, flat_slots=4,
+                            max_new=16, steps_per_call=4):
+    """Quantized KV-arena rows (serving/drift.py harness + the int8 paged
+    engine): capacity, throughput, and quality in one section.
+
+    - **capacity**: `arena_hbm_bytes_per_slot_int8` / `_int4` beside the
+      bf16 row, with the slots-per-chip multiplier ASSERTED: an int8 arena
+      holding >= 1.8x the slots must fit the bf16 arena's KV byte budget,
+      and a full-occupancy wave at that slot count must actually run
+      (every slot concurrently live, every request finished).
+    - **throughput**: `decode_int8_kv_tokens_per_sec` from the timed wave
+      on the int8 engine (fused bursts, same method as the batched rows).
+    - **quality**: the drift harness's `kv_quant_token_match_rate` (int8,
+      greedy, fixed seeds — asserted >= 0.98) and teacher-forced
+      `kv_quant_logit_mse_int8`/`_int4`, so `report --diff` guards both
+      capacity AND quality from this round on.
+    """
+    import dataclasses
+
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.serving.drift import kv_quant_drift
+
+    cap = -(-(prompt_len + max_new) // page_size) * page_size
+    assert cap <= cfg.max_seq_len, (cap, cfg.max_seq_len)
+    cfg = dataclasses.replace(cfg, max_cache_len=cap)
+    model_def = DecoderLM(cfg)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len
+    )
+    params, _ = unbox_params(variables["params"])
+    params = jax.device_put(
+        jax.tree_util.tree_map(lambda x: x.astype(cfg.dtype), params)
+    )
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(flat_slots)]
+    chunks = tuple(sorted({max(page_size, prompt_len // 2), prompt_len}))
+    out = {"page_size": page_size, "max_cache_len": cap}
+
+    # -- drift harness: quality + per-slot bytes per precision. int4
+    # reuses int8's bf16 baseline (same prompts/seeds/engine shape) so
+    # the section pays for ONE bf16 wave, not two.
+    drift = {}
+    baseline = None
+    for kvq in ("int8", "int4"):
+        drift[kvq] = kv_quant_drift(
+            model_def, params, prompts, kv_cache_dtype=kvq,
+            max_new_tokens=max_new, page_size=page_size,
+            num_slots=flat_slots, max_cache_len=cap, prefill_chunks=chunks,
+            seeds=range(flat_slots), baseline=baseline,
+        )
+        baseline = drift[kvq]["baseline"]
+    d8 = drift["int8"]
+    out["arena_hbm_bytes_per_slot"] = d8["arena_bytes_per_slot_bf16"]
+    out["arena_hbm_bytes_per_slot_int8"] = d8["arena_bytes_per_slot_quant"]
+    out["arena_hbm_bytes_per_slot_int4"] = (
+        drift["int4"]["arena_bytes_per_slot_quant"]
+    )
+    out["kv_quant_token_match_rate"] = round(d8["token_match_rate"], 4)
+    out["kv_quant_token_match_rate_int4"] = round(
+        drift["int4"]["token_match_rate"], 4
+    )
+    out["kv_quant_logit_mse_int8"] = d8["logit_mse"]
+    out["kv_quant_logit_mse_int4"] = drift["int4"]["logit_mse"]
+    assert d8["token_match_rate"] >= 0.98, (
+        f"int8 KV arena greedy token-match rate {d8['token_match_rate']:.4f}"
+        " < 0.98 on fixed seeds — storage quantization is perturbing "
+        "generations past the shippable bound (run serving.drift."
+        "kv_quant_drift on this model for the logit breakdown)"
+    )
+
+    # -- >= 1.8x concurrent slots at the bf16 arena's KV byte budget -------
+    ratio = d8["arena_bytes_ratio"]
+    assert ratio >= 1.8, (
+        f"int8 arena shrank KV bytes only {ratio:.2f}x vs bf16 — the "
+        ">=1.8x slots-per-chip contract cannot hold (scale arena too fat?)"
+    )
+    slots_q = int(ratio * flat_slots)
+    quant = ServingEngine(
+        model_def, params, num_slots=slots_q, max_cache_len=cap,
+        prefill_chunks=chunks, page_size=page_size, prefix_cache=False,
+        kv_cache_dtype="int8", steps_per_call=steps_per_call,
+    )
+    quant.telemetry = None
+    assert quant.arena_bytes <= d8["arena_bytes_bf16"] * 1.02, (
+        quant.arena_bytes, d8["arena_bytes_bf16"]
+    )
+    quant.warmup()
+    quant.generate_batched(prompts[:2], max_new_tokens=4)  # host warm
+    quant.mark_steady()
+    quant._step_samples.clear()
+    wave = [rng.randint(0, cfg.vocab_size, (prompt_len,))
+            for _ in range(slots_q)]
+    reqs = [quant.submit(p, max_new_tokens=max_new, seed=i)
+            for i, p in enumerate(wave)]
+    peak = 0
+    while quant._pending():
+        quant.step()
+        peak = max(peak, len(quant._slot_req))
+    assert all(r.outcome == "finished" for r in reqs)
+    assert quant.admission_recompiles == 0, (
+        "int8 arena recompiled post-steady — quantization must be a cache "
+        "dtype, not a program shape"
+    )
+    out["kv_quant_slots_at_bf16_hbm"] = peak
+    out["kv_quant_slots_ratio"] = round(ratio, 2)
+    floor_slots = int(np.ceil(1.8 * flat_slots))
+    assert peak >= slots_q >= floor_slots, (
+        f"int8 arena ran only {peak} concurrent slots at the bf16 budget "
+        f"(needed >= {slots_q}, contract floor {floor_slots})"
+    )
+    samples = list(quant._step_samples)
+    wall = sum(w for w, _, _ in samples)
+    toks = sum(t for _, t, _ in samples)
+    out["decode_int8_kv_tokens_per_sec"] = (
+        round(toks / wall, 1) if wall else None
+    )
+    return out
+
+
+def _decode_block_autotune(cfg, *, length=None, iters=30):
+    """`--tune-decode-block`: sweep the dense-arena decode kernel's
+    ``decode_kernel_block`` over the divisors of the cache length and
+    publish per-block walls + the winner, so real-TPU runs can pin
+    ``DecoderConfig.decode_kernel_block`` from measured data (the PR 8
+    follow-up: block retune was deferred to hardware). On TPU the sweep
+    times the COMPILED kernel; off-TPU it runs the interpreter — the
+    machinery and the published shape are identical, but interpret-mode
+    walls measure the interpreter, so `best_block` is only meaningful on
+    hardware (tagged via `compiled`). head_dim configs failing the
+    kernel's 128-multiple shape gate report `gated: true` and sweep
+    nothing — the head_dim-64 path stays dense by design."""
+    import dataclasses
+
+    from accelerate_tpu.ops.attention import decode_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    d = int(cfg.head_dim or (cfg.embed_dim // cfg.num_heads))
+    L = int(length or min(cfg.max_seq_len, 2048 if on_tpu else 128))
+    out = {"head_dim": d, "length": L, "compiled": bool(on_tpu)}
+    if on_tpu and d % 128:
+        out["gated"] = True
+        out["gate_reason"] = (
+            f"head_dim {d} is not a 128-multiple; the compiled kernel "
+            "falls back dense (retune on a 128-multiple config)"
+        )
+        return out
+    kvh = int(cfg.num_kv_heads or cfg.num_heads)
+    b, h = 8, int(cfg.num_heads)
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), dt)
+    k = jnp.asarray(rng.standard_normal((b, kvh, L, d)), dt)
+    v = jnp.asarray(rng.standard_normal((b, kvh, L, d)), dt)
+    # 75/25 ragged occupancy, like the serving sweep the block serves
+    pos = jnp.asarray(
+        [[L - 1 if i % 4 == 0 else L // 8] for i in range(b)], jnp.int32
+    )
+    impl = None if on_tpu else "interpret"
+    cands = [blk for blk in (16, 32, 64, 128, 256, 512)
+             if blk <= L and L % blk == 0]
+    walls = {}
+    for blk in cands:
+        fn = jax.jit(functools.partial(
+            decode_attention, impl=impl, block_kv=blk
+        ))
+
+        def force(r):
+            # device_get of a scalar slice: block_until_ready does not
+            # actually block through remote-attached runtimes (see the
+            # measurement notes at the top of this file)
+            float(jax.device_get(r[0, 0, 0, 0]))
+
+        force(fn(q, k, v, q_positions=pos))  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(q, k, v, q_positions=pos)
+        force(r)
+        walls[str(blk)] = round(1e3 * (time.perf_counter() - t0) / iters, 4)
+    out["block_ms"] = walls
+    out["best_block"] = int(min(walls, key=walls.get)) if walls else None
+    return out
+
+
 def _serving_isolation_bench(cfg, prompt_len, *, page_size=16, num_slots=2,
                              storm_reqs=4, b_reqs=4, max_new=12,
                              chunk_delay_s=0.004):
@@ -1300,6 +1491,11 @@ def main():
                              "budget < model) and report decode + HBM stats")
     parser.add_argument("--_pipeline_mem", action="store_true",
                         help="internal: print gpipe-vs-1f1b compiled temp bytes")
+    parser.add_argument("--tune-decode-block", action="store_true",
+                        help="sweep decode_kernel_block for the dense-arena "
+                             "decode kernel and publish per-block walls + the "
+                             "winner (meaningful on real TPU; CPU runs the "
+                             "interpreter to prove the machinery)")
     parser.add_argument("--telemetry-out", default=None, metavar="PATH",
                         help="write the headline train bench's per-step runtime-"
                              "telemetry records (step wall, tokens/s, live MFU) "
@@ -1414,6 +1610,15 @@ def main():
         fp8_tok_s, fp8_mfu, _, _ = _train_bench(flagship, 8, 2048, 10, "fp8")
         extra["fp8_train_mfu_pct"] = round(fp8_mfu * 100, 2)
         extra["fp8_tokens_per_sec"] = round(fp8_tok_s)
+        # fp8 forensics pass (ROADMAP 5b): the SAME recompile-forensics +
+        # per-executable-roofline wave the bf16 leg runs, pointed at the
+        # fp8 step — fp8_train_recompiles_diagnosed localizes any
+        # retracing, fp8_train_step_mfu_model is XLA's own cost model over
+        # the measured wall (vs the bf16 row above, the gap IS the
+        # emulation tax docs/fp8.md quantifies on pre-fp8-MXU silicon)
+        _publish_goodput_rows(extra, flagship, 8, 2048, 6, "fp8",
+                              None, fp8_tok_s, prefix="fp8_train_")
+        extra["fp8_vs_bf16_mfu_ratio"] = round(fp8_mfu / mfu, 3) if mfu else None
 
         import tempfile
 
@@ -1470,6 +1675,21 @@ def main():
         extra["decode_spec_tokens_per_sec"] = extra["serving_paged"]["decode_spec_tokens_per_sec"]
         extra["spec_accept_rate"] = extra["serving_paged"]["spec_accept_rate"]
         extra["arena_hbm_bytes_per_slot"] = extra["serving_paged"]["arena_hbm_bytes_per_slot"]
+
+        # quantized KV arena (serving/drift.py): >=1.8x slots at the bf16
+        # KV budget, int8 decode throughput, and the drift-quality bound —
+        # all asserted, all regression-guarded via report --diff
+        extra["serving_kv_quant"] = _serving_kv_quant_bench(
+            ttft_cfg, 128, page_size=64, flat_slots=8, max_new=32,
+        )
+        for key in ("arena_hbm_bytes_per_slot_int8",
+                    "arena_hbm_bytes_per_slot_int4",
+                    "kv_quant_token_match_rate",
+                    "decode_int8_kv_tokens_per_sec"):
+            extra[key] = extra["serving_kv_quant"][key]
+
+        if args.tune_decode_block:
+            extra["decode_block_autotune"] = _decode_block_autotune(ttft_cfg)
 
         # ragged-occupancy decode: the pallas paged kernel vs the gathered
         # masked-dense read at 75% short / 25% long slots (asserted >= 1x)
@@ -1570,6 +1790,19 @@ def main():
         extra["decode_spec_tokens_per_sec"] = extra["serving_paged"]["decode_spec_tokens_per_sec"]
         extra["spec_accept_rate"] = extra["serving_paged"]["spec_accept_rate"]
         extra["arena_hbm_bytes_per_slot"] = extra["serving_paged"]["arena_hbm_bytes_per_slot"]
+        extra["serving_kv_quant"] = _serving_kv_quant_bench(
+            DecoderConfig.tiny(max_seq_len=256), 32, page_size=16,
+            flat_slots=2, max_new=16, steps_per_call=2,
+        )
+        for key in ("arena_hbm_bytes_per_slot_int8",
+                    "arena_hbm_bytes_per_slot_int4",
+                    "kv_quant_token_match_rate",
+                    "decode_int8_kv_tokens_per_sec"):
+            extra[key] = extra["serving_kv_quant"][key]
+        if args.tune_decode_block:
+            extra["decode_block_autotune"] = _decode_block_autotune(
+                DecoderConfig.tiny(max_seq_len=256)
+            )
         extra["serving_ragged"] = _serving_ragged_bench(
             DecoderConfig.tiny(max_seq_len=256), 32, num_slots=4,
             page_size=16, max_new=12, steps_per_call=4,

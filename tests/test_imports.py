@@ -95,6 +95,12 @@ class TestNoEagerHeavyImports:
             "alloc = pages.PageAllocator(8)\n"
             "cache = pages.PrefixCache(alloc, page_size=4)\n"
             "pages.NGramDrafter()\n"
+            "# the quantized-arena capacity helpers are part of the same\n"
+            "# jax-free contract: a router sizes int8/int4 KV budgets with\n"
+            "# these on accelerator-less machines\n"
+            "assert pages.kv_cache_bits('int8') == 8\n"
+            "assert pages.kv_payload_width(64, 'int4') == 32\n"
+            "assert pages.kv_token_bytes(2, 64, 'int8', num_layers=4) > 0\n"
             "heavy = {m for m in ('jax', 'flax') if m in sys.modules}\n"
             "assert not heavy, f'serving.pages import pulled {heavy}'"
         )
