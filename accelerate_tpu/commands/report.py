@@ -237,9 +237,11 @@ def load_timeline_summary(target: str) -> dict:
 
 
 def load_alert_summary(target: str) -> dict:
-    """Alert history out of ``alerts-host*.jsonl``: per-rule final state
-    + fired/resolved counts, plus the raw event list."""
-    if not _host_files(target, "alerts-host*.jsonl"):
+    """Alert history out of ``alerts-host*.jsonl`` (and the fleet
+    collector's ``alerts-fleet.jsonl``): per-rule final state +
+    fired/resolved counts, plus the raw event list."""
+    if not (_host_files(target, "alerts-host*.jsonl")
+            or _host_files(target, "alerts-fleet.jsonl")):
         return {}
     from ..telemetry.alerts import load_alerts
 
@@ -252,6 +254,19 @@ def load_usage_table(target: str) -> dict:
     from ..telemetry.usage import load_usage
 
     return load_usage(target)
+
+
+def load_fleet_summary(target: str) -> dict:
+    """Fleet-collector artifacts (``fleet.json`` snapshot +
+    ``fleet-events.jsonl`` health transitions) under the telemetry dir —
+    present when a :class:`~..telemetry.fleet.FleetCollector` ran with
+    ``log_dir`` pointed here."""
+    if not (_host_files(target, "fleet.json")
+            or _host_files(target, "fleet-events.jsonl")):
+        return {}
+    from ..telemetry.fleet import load_fleet
+
+    return load_fleet(target)
 
 
 def load_report(target: str) -> dict:
@@ -267,6 +282,7 @@ def load_report(target: str) -> dict:
         "timeline": load_timeline_summary(target),
         "alerts": load_alert_summary(target),
         "usage": load_usage_table(target),
+        "fleet": load_fleet_summary(target),
     }
     req_files = _host_files(target, "requests-host*.jsonl")
     if req_files:
@@ -415,6 +431,42 @@ def format_report(data: dict) -> str:
                 + (f", last value {r.get('last_value')}"
                    if r.get("last_value") is not None else "")
             )
+
+    fleet = data.get("fleet") or {}
+    replicas = fleet.get("replicas") or {}
+    if replicas:
+        gauges = fleet.get("fleet") or {}
+        down = gauges.get("fleet/replicas_down", 0)
+        lines.append("")
+        lines.append(
+            f"fleet: {len(replicas)} replica(s), "
+            f"{gauges.get('fleet/replicas_placeable', '?')} placeable, "
+            f"{down} down ({fleet.get('polls', '?')} polls)"
+        )
+        header = ("replica", "state", "load_score", "scrapes_ok",
+                  "scrapes_failed", "last_ok_age_s")
+        table = [header]
+        placement = fleet.get("placement") or []
+        order = [p["replica"] for p in placement if p["replica"] in replicas]
+        order += [n for n in sorted(replicas) if n not in order]
+        for name in order:
+            r = replicas[name]
+            score = r.get("load_score")
+            table.append((
+                name, r.get("state", "?"),
+                f"{score:.3f}" if isinstance(score, float) else str(score),
+                str(r.get("scrapes_ok", "")), str(r.get("scrapes_failed", "")),
+                str(r.get("last_ok_age_s", "")),
+            ))
+        lines.extend(render_table(table))
+        events = fleet.get("events") or []
+        if events:
+            lines.append(f"  health transitions ({len(events)}):")
+            for evt in events[-8:]:
+                lines.append(
+                    f"    @{evt.get('t_unix_s', 0):.0f} {evt.get('replica')}: "
+                    f"{evt.get('from')} -> {evt.get('to')} ({evt.get('reason')})"
+                )
 
     usage = data.get("usage") or {}
     tenants = usage.get("tenants") or {}
@@ -582,10 +634,11 @@ def report_command(args) -> int:
     data = load_report(args.target)
     if not (data["goodput"] or data["costs"].get("executables")
             or data["recompiles"] or data["first_compiles"] or data["steps"]
-            or data["timeline"] or data["usage"] or data["alerts"]):
+            or data["timeline"] or data["usage"] or data["alerts"]
+            or data["fleet"]):
         print(f"no telemetry artifacts found under {args.target} — expected "
               "goodput-host*.json / costs-host*.json / forensics-host*.jsonl "
-              "(see docs/telemetry.md)", file=sys.stderr)
+              "/ fleet.json (see docs/telemetry.md)", file=sys.stderr)
         return 1
     if args.json:
         print(json.dumps(data))

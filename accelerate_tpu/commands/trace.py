@@ -35,10 +35,25 @@ def _span_files(target: str) -> list:
     return [target]
 
 
-def _request_files(target: str) -> list:
-    if os.path.isdir(target):
-        return sorted(glob.glob(os.path.join(target, "requests-host*.jsonl")))
-    return [target]
+def _request_files(target) -> list:
+    """Request-log files for one target or a list of targets (each a
+    telemetry dir or one ``requests-host*.jsonl``) — N replicas each own
+    a telemetry dir, and stitching needs all of them at once."""
+    targets = [target] if isinstance(target, str) else list(target)
+    out = []
+    for t in targets:
+        if os.path.isdir(t):
+            out.extend(sorted(glob.glob(os.path.join(t, "requests-host*.jsonl"))))
+        else:
+            out.append(t)
+    return out
+
+
+def _same_id(a, b) -> bool:
+    """Request-id equality across int/str sources (the CLI arg is a
+    string; engine-assigned ids are ints, router-supplied ids may be
+    either)."""
+    return a == b or str(a) == str(b)
 
 
 def merge_traces(target: str, request_id=None) -> dict:
@@ -75,7 +90,8 @@ def merge_traces(target: str, request_id=None) -> dict:
                 merged.append(e)
                 continue
             if request_id is not None:
-                if (e.get("args") or {}).get("request_id") != request_id:
+                if not _same_id((e.get("args") or {}).get("request_id"),
+                                request_id):
                     continue
             if shift_us and "ts" in e:
                 e = dict(e, ts=round(e["ts"] + shift_us, 3))
@@ -84,8 +100,9 @@ def merge_traces(target: str, request_id=None) -> dict:
     return {"traceEvents": merged}
 
 
-def load_requests(target: str) -> list:
-    """Every request record in the dir/file, tagged with its source host."""
+def load_requests(target) -> list:
+    """Every request record in the dir(s)/file(s), tagged with its source
+    host (``target`` may be a list of telemetry dirs — one per replica)."""
     out = []
     for path in _request_files(target):
         name = os.path.basename(path)
@@ -162,6 +179,69 @@ def summarize_requests(records: list) -> dict:
     return agg
 
 
+def stitch_request(records: list) -> dict:
+    """Merge one logical request's records — one per replica hop — into
+    a hop-by-hop timeline. A router re-queuing a request (replica died,
+    preemptive re-placement) submits the SAME external ``request_id`` to
+    each replica; each replica's log holds its own hop. Hops order by
+    submit time; ``gap_ms`` is the hand-off latency between one hop's
+    finish and the next hop's submit (the router's re-queue cost)."""
+    hops = sorted(records, key=lambda r: r.get("submit_unix_s", 0))
+    out_hops = []
+    prev_finish = None
+    for i, rec in enumerate(hops):
+        hop = {
+            "hop": i,
+            "replica": rec.get("replica") or rec.get("host", "?"),
+            "submit_unix_s": rec.get("submit_unix_s"),
+            "queue_wait_ms": rec.get("queue_wait_ms"),
+            "ttft_ms": rec.get("ttft_ms"),
+            "tokens": rec.get("tokens", 0),
+            "total_ms": rec.get("total_ms"),
+            "outcome": rec.get("outcome"),
+            "finish_reason": rec.get("finish_reason"),
+            "preemptions": rec.get("preemptions", 0),
+        }
+        submit = rec.get("submit_unix_s")
+        if prev_finish is not None and submit is not None:
+            hop["gap_ms"] = round((submit - prev_finish) * 1e3, 3)
+        prev_finish = rec.get("finish_unix_s")
+        out_hops.append(hop)
+    first = hops[0].get("submit_unix_s")
+    last = hops[-1].get("finish_unix_s")
+    return {
+        "request_id": hops[0].get("request_id"),
+        "hops": out_hops,
+        "hop_count": len(out_hops),
+        "tokens": sum(h["tokens"] or 0 for h in out_hops),
+        "end_to_end_ms": (
+            round((last - first) * 1e3, 3)
+            if first is not None and last is not None else None
+        ),
+        "outcome": out_hops[-1].get("outcome"),
+    }
+
+
+def _format_stitched(stitched: dict) -> str:
+    from .report import render_table  # the one shared table renderer
+
+    rows = [("hop", "replica", "queue_ms", "ttft_ms", "tokens", "total_ms",
+             "gap_ms", "outcome", "reason")]
+    for h in stitched["hops"]:
+        rows.append((
+            h["hop"], h["replica"], h.get("queue_wait_ms", ""),
+            h.get("ttft_ms", ""), h.get("tokens", ""),
+            h.get("total_ms", ""), h.get("gap_ms", ""),
+            h.get("outcome", ""), h.get("finish_reason", ""),
+        ))
+    lines = [f"request {stitched['request_id']}: {stitched['hop_count']} hop(s) "
+             f"across replicas, {stitched['tokens']} tokens"
+             + (f", end-to-end {stitched['end_to_end_ms']} ms"
+                if stitched.get("end_to_end_ms") is not None else "")]
+    lines.extend(render_table(rows, indent=""))
+    return "\n".join(lines)
+
+
 def _format_table(records: list, agg: dict) -> str:
     cols = ("id", "host", "slot", "prompt", "tokens", "queue_ms", "ttft_ms",
             "itl_p50_ms", "total_ms", "reason")
@@ -218,11 +298,21 @@ def trace_command(args) -> int:
             print(f"no request records found under {args.target}", file=sys.stderr)
             return 1
         if args.request_id is not None:
-            records = [r for r in records if r.get("request_id") == args.request_id]
+            records = [r for r in records
+                       if _same_id(r.get("request_id"), args.request_id)]
             if not records:
                 print(f"request id {args.request_id} not in the log", file=sys.stderr)
                 return 1
-            print(json.dumps(records if len(records) > 1 else records[0], indent=2))
+            if len(records) > 1:
+                # one logical request, several replica hops: stitch them
+                # into the hop-by-hop timeline instead of a record dump
+                stitched = stitch_request(records)
+                if args.json:
+                    print(json.dumps({"stitched": stitched, "records": records}))
+                else:
+                    print(_format_stitched(stitched))
+                return 0
+            print(json.dumps(records[0], indent=2))
             return 0
         agg = summarize_requests(records)
         if args.json:
@@ -244,14 +334,22 @@ def register(subparsers):
     )
     merge.add_argument("target", help="telemetry dir (or one trace-host*.jsonl)")
     merge.add_argument("-o", "--output", default=None, help="output path (default: stdout)")
-    merge.add_argument("--request-id", type=int, default=None,
+    merge.add_argument("--request-id", default=None,
                        help="keep only this request's spans")
     summary = sub.add_parser(
         "summary", help="Summarize request-log JSONL(s) into a latency table"
     )
-    summary.add_argument("target", help="telemetry dir (or one requests-host*.jsonl)")
-    summary.add_argument("--request-id", type=int, default=None,
-                         help="print one request's full lifecycle record")
+    summary.add_argument(
+        "target", nargs="+",
+        help="telemetry dir(s) (or requests-host*.jsonl files) — pass one "
+             "dir per replica to merge a fleet's request logs",
+    )
+    summary.add_argument(
+        "--request-id", default=None,
+        help="print one request's full lifecycle record; with records "
+             "from several replicas, stitch them into the hop-by-hop "
+             "timeline",
+    )
     summary.add_argument("--json", action="store_true", help="machine-readable output")
     parser.set_defaults(func=trace_command)
     return parser

@@ -20,6 +20,13 @@ Two data sources:
 
 ``--once`` renders a single frame and exits (scripting / tests);
 ``--series`` overrides which gauges get sparklines.
+
+**Fleet mode** (``--fleet``, target = comma-separated replica scrape
+URLs and/or telemetry dirs) runs a :class:`~..telemetry.fleet.FleetCollector`
+client-side and renders the ranked replica health/placement table (the
+same ``placement_view()`` the router consumes), fleet-aggregate
+sparklines (counters summed, latency quantiles exactly merged from the
+native histograms), firing fleet alerts, and recent health transitions.
 """
 
 from __future__ import annotations
@@ -86,29 +93,14 @@ def _fmt_num(v) -> str:
 def parse_prometheus(text: str) -> tuple:
     """→ ``(gauges, alerts)``: ``att_*`` gauge lines as a flat dict (the
     ``att_`` prefix stripped), and ``att_alert_firing{rule=...}`` series
-    as ``{rule: 0/1}``."""
-    gauges, alerts = {}, {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        name, _, value = line.rpartition(" ")
-        name = name.strip()
-        try:
-            v = float(value)
-        except ValueError:
-            continue
-        if name.startswith("att_alert_firing{"):
-            rule = name[len("att_alert_firing{"):].rstrip("}")
-            if rule.startswith('rule="') and rule.endswith('"'):
-                rule = rule[len('rule="'):-1]
-                alerts[rule.replace('\\"', '"').replace("\\\\", "\\")] = int(v)
-            continue
-        if "{" in name:  # histogram buckets: the _p50/_p95/_p99 gauges suffice
-            continue
-        if name.startswith("att_"):
-            gauges[name[len("att_"):]] = v
-    return gauges, alerts
+    as ``{rule: 0/1}``. Delegates to THE hardened exposition parser in
+    ``telemetry.fleet`` (NaN/±Inf values, escaped labels, torn lines) —
+    one parser for ``watch`` and the fleet collector, so they can never
+    drift."""
+    from ..telemetry.fleet import parse_exposition
+
+    snap = parse_exposition(text)
+    return snap.gauges, snap.alerts
 
 
 def fetch_metrics(url: str, timeout_s: float = 5.0) -> tuple:
@@ -258,7 +250,122 @@ def _build_frame(target: str, history: dict, span_s: float) -> dict:
     return frame
 
 
+# -- fleet mode -------------------------------------------------------------
+
+FLEET_SERIES = (
+    "serving/tokens_per_s",
+    "serving/itl_p99_ms",
+    "serving/queue_depth",
+    "serving/pages_in_use",
+    "fleet/replicas_placeable",
+    "fleet/replicas_down",
+)
+FLEET_COLUMNS = ("replica", "state", "load", "queue", "free_slots",
+                 "tok/s", "itl_p99", "age_s")
+
+
+def render_fleet_frame(collector, series_keys, width: int = 32,
+                       span_s: float = 600.0) -> str:
+    """One ``watch --fleet`` frame: the ranked replica table (state +
+    load score — the same placement_view() the router consumes), the
+    fleet-aggregate sparklines, firing fleet alerts, and the most recent
+    health transitions."""
+    from .report import render_table
+
+    tl = collector.timeline
+    now = tl.last_t
+    lines = [
+        f"accelerate-tpu watch --fleet · {len(collector.replicas)} replicas"
+        f" · {time.strftime('%H:%M:%S')} · poll {collector.polls}"
+    ]
+    lines.append("")
+    table = [FLEET_COLUMNS]
+    for row in collector.placement_view(include_unplaceable=True):
+        score = row.get("load_score")
+        table.append((
+            row["replica"],
+            row["state"] + ("" if row["placeable"] else " ✗"),
+            _fmt_num(round(score, 3) if isinstance(score, float) else score),
+            _fmt_num(row.get("queue_depth")),
+            _fmt_num(row.get("free_slots")),
+            _fmt_num(row.get("tokens_per_s")),
+            _fmt_num(row.get("itl_recent_p99_ms")),
+            _fmt_num(row.get("last_ok_age_s")),
+        ))
+    lines.extend(render_table(table))
+    lines.append("")
+    if now is not None:
+        for key in series_keys:
+            pts = tl.series(key, span_s, now=now)
+            if not pts:
+                continue
+            hist = [v for _, v in pts]
+            lines.append(
+                f"  {key:<32} {_fmt_num(hist[-1]):>10}  "
+                f"{sparkline(hist, width)}  "
+                f"[{_fmt_num(min(hist))} .. {_fmt_num(max(hist))}]"
+            )
+    states = collector.alerts.states_snapshot()
+    firing = sorted(n for n, st in states.items() if st["state"] == "firing")
+    lines.append("")
+    if firing:
+        lines.append("  ALERTS FIRING: " + ", ".join(firing))
+    if states:
+        quiet = sorted(n for n in states if n not in firing)
+        lines.append("  alerts ok: " + (", ".join(quiet) if quiet else "(none)"))
+    else:
+        lines.append("  alerts: (none configured)")
+    events = collector.events[-5:]
+    if events:
+        lines.append("")
+        lines.append("  recent health transitions:")
+        for evt in events:
+            lines.append(
+                f"    {evt['replica']}: {evt['from']} -> {evt['to']} "
+                f"({evt['reason']})"
+            )
+    return "\n".join(lines)
+
+
+def watch_fleet_command(args) -> int:
+    from ..telemetry.fleet import FleetCollector
+
+    targets = [t.strip() for t in args.target.split(",") if t.strip()]
+    series = (
+        [s.strip() for s in args.series.split(",") if s.strip()]
+        if args.series else list(FLEET_SERIES)
+    )
+    try:
+        collector = FleetCollector(
+            targets,
+            poll_interval_s=args.interval,
+            stale_after_s=args.stale_after,
+            dead_after_s=args.dead_after,
+        )
+    except ValueError as e:
+        print(f"watch --fleet: {e}", file=sys.stderr)
+        return 1
+    try:
+        while True:
+            collector.poll_once()
+            text = render_fleet_frame(collector, series, width=args.width,
+                                      span_s=args.span)
+            if args.once:
+                print(text)
+                return 0
+            sys.stdout.write("\x1b[H\x1b[2J" + text + "\n")
+            sys.stdout.flush()
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+    finally:
+        collector.close()
+
+
 def watch_command(args) -> int:
+    if getattr(args, "fleet", False):
+        return watch_fleet_command(args)
     history: dict = {}
     series = (
         [s.strip() for s in args.series.split(",") if s.strip()]
@@ -300,8 +407,21 @@ def register(subparsers):
     parser.add_argument(
         "target",
         help="scrape URL (http://host:port/metrics) or telemetry dir "
-             "(timeline-host*.jsonl / alerts-host*.jsonl / usage-host*.json)",
+             "(timeline-host*.jsonl / alerts-host*.jsonl / usage-host*.json); "
+             "with --fleet, a comma-separated list of replica URLs/dirs",
     )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="fleet mode: poll N replica scrape endpoints (comma-separated "
+             "target), render the ranked replica health/placement table + "
+             "fleet-aggregate sparklines (telemetry/fleet.py)",
+    )
+    parser.add_argument("--stale-after", type=float, default=10.0,
+                        help="fleet mode: sample age marking a replica "
+                             "degraded (default 10s)")
+    parser.add_argument("--dead-after", type=float, default=15.0,
+                        help="fleet mode: unreachable time marking a replica "
+                             "dead (default 15s)")
     parser.add_argument("--interval", type=float, default=2.0,
                         help="refresh cadence in seconds (default 2)")
     parser.add_argument("--once", action="store_true",

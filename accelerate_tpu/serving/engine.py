@@ -32,6 +32,7 @@ so ``generate_batched()`` output is token-for-token equal to sequential
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -96,11 +97,15 @@ class Request:
     max_new_tokens: int
     rng: jax.Array
     on_token: Optional[Callable] = None
-    id: int = -1
+    # engine-assigned int, or the caller's externally-supplied request_id
+    # (int or str) — a router re-queuing a request across replicas keeps
+    # one id so `accelerate-tpu trace` can stitch the hops back together
+    id: object = -1
     tenant: str = "default"
     priority: int = 0
     deadline_s: Optional[float] = None   # scheduling hint (EDF within class)
     timeout_s: Optional[float] = None    # hard wall from submit to cancel
+    replica: Optional[str] = None        # which engine served this hop
 
     # runtime state (engine-owned)
     tokens: list = field(default_factory=list)
@@ -204,6 +209,7 @@ class ServingEngine:
         scheduler=None,
         faults=None,
         kv_cache_dtype: Optional[str] = None,
+        replica: Optional[str] = None,
     ):
         from ..utils.compile_cache import (
             compile_event_counters,
@@ -380,16 +386,27 @@ class ServingEngine:
         self._faults = faults
         self._prefill_credit = 0.0
         self._draining = False
+        # fleet identity: stamped onto every request record so the trace
+        # CLI can stitch a re-queued request's hops across replicas
+        # (ATT_REPLICA is how a launcher names its N engine processes)
+        self.replica = (
+            str(replica) if replica else (os.environ.get("ATT_REPLICA") or None)
+        )
 
         self._queue: deque = deque()
         self._free = list(range(self.num_slots))[::-1]  # pop() -> slot 0 first
         self._slot_req: dict = {}
         self._admitting = None
-        # itertools.count is effectively atomic under the GIL — serve()
-        # advertises submit() from another thread
-        import itertools
+        # request-id assignment: a plain counter under a lock (serve()
+        # advertises submit() from another thread). Kept as an attribute
+        # (not itertools.count) so an externally-supplied int request_id
+        # can bump it PAST itself — the tracer/scheduler key per-request
+        # state by id, and an auto id later colliding with a router's
+        # int id would silently merge two requests' records
+        import threading
 
-        self._next_id = itertools.count()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
 
         self._step_core = self._build_step_core()
         donate = (1, 2, 3, 5) if self._donate else ()
@@ -742,11 +759,21 @@ class ServingEngine:
         priority: int = 0,
         deadline_s: Optional[float] = None,
         timeout_s: Optional[float] = None,
+        request_id=None,
     ) -> Request:
         """Queue one request; returns its live :class:`Request` handle.
         ``rng``/``seed`` match ``generate(..., rng=...)``: the same seed
         yields the same tokens the single-stream loop would produce.
         ``on_token(token_id, request)`` fires as each token is emitted.
+
+        ``request_id`` (int or str) overrides the engine-assigned id: a
+        router submitting one logical request to several replicas (e.g.
+        a re-queue after a replica death) passes the same id to each hop
+        so the per-replica request logs stitch back into one timeline
+        (``accelerate-tpu trace summary --request-id``). The caller owns
+        uniqueness among its own ids; an external *int* id also bumps the
+        engine's auto counter past itself, so auto-assigned ids can never
+        collide with it.
 
         With a scheduler attached, ``tenant``/``priority``/``deadline_s``
         drive the weighted-fair, priority-classed queue, and admission
@@ -781,16 +808,26 @@ class ServingEngine:
                 + f" exceeds the slot KV capacity ({self.max_cache_len}); "
                 "raise max_cache_len"
             )
+        with self._id_lock:
+            if request_id is None:
+                rid = self._next_id
+                self._next_id += 1
+            else:
+                rid = request_id
+                if isinstance(rid, int) and rid >= self._next_id:
+                    # never hand this id out as an auto id later
+                    self._next_id = rid + 1
         req = Request(
             prompt=prompt,
             max_new_tokens=int(max_new_tokens),
             rng=rng if rng is not None else jax.random.PRNGKey(seed),
             on_token=on_token,
-            id=next(self._next_id),
+            id=rid,
             tenant=str(tenant or "default"),
             priority=int(priority),
             deadline_s=deadline_s,
             timeout_s=timeout_s,
+            replica=self.replica,
         )
         req.submit_t = time.perf_counter()
         tr = self._tracer()
@@ -1949,6 +1986,28 @@ class ServingEngine:
             )
         if self._steady_mark is not None:
             out["serving/admission_recompiles"] = self.admission_recompiles
+        # the placement-signal contract (telemetry/fleet.py, documented in
+        # docs/telemetry.md "Fleet view"): one comparable scalar a router
+        # ranks replicas by, plus the raw components it folds — exported
+        # by EVERY engine, flat or paged, scheduler or not
+        from ..telemetry.fleet import load_score
+
+        out["serving/num_slots"] = self.num_slots
+        out["serving/free_slots"] = self.num_slots - len(self._slot_req)
+        if self.page_size:
+            out["serving/free_pages"] = self._allocator.free_count
+        out["serving/load_score"] = load_score(
+            queue_depth=out["serving/queue_depth"],
+            num_slots=self.num_slots,
+            slot_occupancy=out["serving/slot_occupancy"],
+            free_pages=out.get("serving/free_pages"),
+            pages_total=self.num_pages if self.page_size else None,
+            itl_recent_p99_ms=out.get("serving/itl_recent_p99_ms"),
+            itl_slo_ms=(
+                self._sched.config.itl_slo_ms if self._sched is not None else None
+            ),
+            draining=self._draining,
+        )
         return out
 
     @classmethod

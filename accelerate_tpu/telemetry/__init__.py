@@ -350,6 +350,13 @@ class TelemetrySession:
             from .usage import UsageAccountant
 
             self.usage = UsageAccountant()
+        # freshness clock for the exposition's att_scrape_age_seconds:
+        # advanced by every sample_timeline() tick, so a fleet collector
+        # can tell a frozen sampler from a frozen replica. None until the
+        # first sample (and forever on a timeline-less session): exporting
+        # an age no sampler will ever advance would read as a permanently
+        # degrading replica
+        self.last_sample_unix_s = None
         self.timeline = None
         self.alerts = None
         self._sampler = None
@@ -495,6 +502,10 @@ class TelemetrySession:
             return {}
         values = self.host_rollup()
         t = tl.add_sample(values, now=now)
+        # wall clock, not `now`: deterministic tests drive `now` with a
+        # fake clock, but the exposition's staleness gauge answers "when
+        # did this session last actually sample" in real time
+        self.last_sample_unix_s = time.time()
         if self.usage is not None:
             self.usage.mark()
         if self.alerts is not None:

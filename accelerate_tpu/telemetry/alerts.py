@@ -471,14 +471,21 @@ def load_alerts(target: str) -> dict:
     import glob
 
     if os.path.isdir(target):
-        paths = sorted(glob.glob(os.path.join(target, "alerts-host*.jsonl")))
+        paths = sorted(
+            glob.glob(os.path.join(target, "alerts-host*.jsonl"))
+            # the fleet collector's rule evaluations (telemetry/fleet.py)
+            # land beside the per-host logs and merge the same way
+            + glob.glob(os.path.join(target, "alerts-fleet.jsonl"))
+        )
     elif os.path.exists(target):
         paths = [target]
     else:
         paths = []
     events = []
     for path in paths:
-        host = os.path.basename(path).split(".", 1)[0].replace("alerts-host", "")
+        host = os.path.basename(path).split(".", 1)[0]
+        host = (host.replace("alerts-host", "") if host.startswith("alerts-host")
+                else host.replace("alerts-", ""))
         try:
             with open(path) as fh:
                 for line in fh:

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from typing import Optional
 
 # exposition metric names allow [a-zA-Z_:][a-zA-Z0-9_:]*; the att_ prefix
@@ -113,6 +114,18 @@ def prometheus_text(session) -> str:
         name = _metric_name(key)
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {_fmt(v)}")
+    # freshness marker: seconds since the session last folded a timeline
+    # sample (i.e. since its gauges were last known to be advancing). A
+    # fleet collector uses this to tell a frozen *session* (endpoint
+    # answers, sampler dead, age grows -> replica "degraded") from a
+    # frozen *replica* (scrape fails -> "unreachable").
+    last_sample = getattr(session, "last_sample_unix_s", None)
+    if isinstance(last_sample, (int, float)) and last_sample > 0:
+        lines.append(f"# TYPE {PREFIX}scrape_age_seconds gauge")
+        lines.append(
+            f"{PREFIX}scrape_age_seconds "
+            f"{_fmt(max(0.0, time.time() - last_sample))}"
+        )
     alerts = getattr(session, "alerts", None)
     if alerts is not None:
         try:
@@ -171,6 +184,12 @@ class ScrapeServer:
         exporter = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            # a slow or wedged client only ever costs its own handler
+            # thread (ThreadingHTTPServer below), and that thread is
+            # reclaimed by the socket timeout — a stuck fleet poller must
+            # not block the on-call's manual curl, or accumulate threads
+            timeout = 10.0
+
             def do_GET(self):  # noqa: N802 (stdlib casing)
                 if self.path not in ("/metrics", "/"):
                     self.send_error(404)
@@ -213,6 +232,10 @@ class ScrapeServer:
                     "endpoint disabled", host, port, first_err,
                 )
                 return
+        # concurrent scrapes must never serialize behind one slow client:
+        # each request gets its own daemon thread (explicit — the close()
+        # join must not wait out a client that never finishes reading)
+        self.server.daemon_threads = True
         self.port = self.server.server_address[1]
         self._thread = threading.Thread(
             target=self.server.serve_forever, name="att-telemetry-exporter",

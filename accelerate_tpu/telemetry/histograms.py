@@ -93,10 +93,18 @@ class StreamingHistogram:
         return out
 
     def merge(self, other: "StreamingHistogram"):
-        """Fold another histogram (same lo/growth) in — the per-host merge
-        the ``trace`` CLI uses when summarizing multi-host request logs."""
+        """Fold another histogram in — the primitive behind multi-host
+        ``trace``/``report`` summaries and the fleet collector's exact
+        cross-replica quantiles. Bucket layouts must align exactly
+        (``lo``/``growth`` identical, which they are by construction for
+        every default-layout session); a mismatch **raises** rather than
+        silently misbinning — a wrong fleet p99 is worse than no fleet
+        p99."""
         if (other.lo, other.growth) != (self.lo, self.growth):
-            raise ValueError("histogram layouts differ; cannot merge")
+            raise ValueError(
+                f"histogram layouts differ (lo/growth {self.lo}/{self.growth} "
+                f"vs {other.lo}/{other.growth}); cannot merge"
+            )
         for idx, n in other.counts.items():
             self.counts[idx] = self.counts.get(idx, 0) + n
         self.count += other.count
@@ -105,6 +113,46 @@ class StreamingHistogram:
             self.min = other.min if self.min is None else min(self.min, other.min)
         if other.max is not None:
             self.max = other.max if self.max is None else max(self.max, other.max)
+
+    @classmethod
+    def from_cumulative(cls, buckets, *, sum_value: float = 0.0,
+                        lo: float = 1e-6, growth: float = 1.25,
+                        tolerance: float = 0.01) -> "StreamingHistogram":
+        """Rebuild a histogram from exposition-format cumulative buckets
+        (``[(le_seconds, cumulative_count), ...]`` — the inverse of
+        :meth:`cumulative_buckets`, which is how the fleet collector
+        turns a replica's scrape back into a mergeable histogram.
+
+        Every ``le`` edge must land on the ``lo * growth**i`` grid
+        (within ``tolerance`` of an integer exponent, covering the
+        ``%.9g`` rendering); an off-grid edge raises ``ValueError`` —
+        a replica running a custom layout must be skipped, not misbinned.
+        ``min``/``max`` are unknowable from the exposition and stay
+        ``None`` (quantiles lose only the endpoint clamp, which moves an
+        estimate within its own bucket — inside the usual ~12% bound)."""
+        h = cls(lo=lo, growth=growth)
+        prev = 0
+        for le, cum in sorted(buckets):
+            n = int(cum) - prev
+            prev = int(cum)
+            if n < 0:
+                raise ValueError("cumulative bucket counts must be ascending")
+            if n == 0:
+                continue
+            if le <= lo * (1 + tolerance):
+                idx = 0
+            else:
+                exponent = math.log(le / lo) / math.log(growth)
+                idx = int(round(exponent))
+                if abs(exponent - idx) > tolerance or idx < 0:
+                    raise ValueError(
+                        f"bucket edge {le!r} is not on the lo={lo} "
+                        f"growth={growth} grid"
+                    )
+            h.counts[idx] = h.counts.get(idx, 0) + n
+        h.count = prev
+        h.sum = float(sum_value)
+        return h
 
     def snapshot(self) -> dict:
         """{count, sum_s, min_s, max_s, mean_s, p50_s, p95_s, p99_s} or {}."""
@@ -128,11 +176,13 @@ def percentile_keys(name: str, hist: StreamingHistogram) -> dict:
     snap = hist.snapshot()
     if not snap:
         return {}
-    return {
-        f"{name}_count": snap["count"],
-        f"{name}_p50_ms": round(snap["p50_s"] * 1e3, 3),
-        f"{name}_p95_ms": round(snap["p95_s"] * 1e3, 3),
-        f"{name}_p99_ms": round(snap["p99_s"] * 1e3, 3),
-        f"{name}_mean_ms": round(snap["mean_s"] * 1e3, 3),
-        f"{name}_max_ms": round(snap["max_s"] * 1e3, 3),
-    }
+    out = {f"{name}_count": snap["count"]}
+    for field, key in (("p50_s", "p50_ms"), ("p95_s", "p95_ms"),
+                       ("p99_s", "p99_ms"), ("mean_s", "mean_ms"),
+                       ("max_s", "max_ms")):
+        v = snap.get(field)
+        # a histogram rebuilt from exposition buckets (from_cumulative)
+        # has no observed min/max — skip those keys, don't crash rollups
+        if v is not None:
+            out[f"{name}_{key}"] = round(v * 1e3, 3)
+    return out

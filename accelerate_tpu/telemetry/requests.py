@@ -88,6 +88,12 @@ class RequestTracer:
             "compiles_at_submit": self._compiles(),
             "last_event": ("submit", time.time()),
         }
+        # fleet identity: which replica served this hop. A re-queued
+        # request keeps its external request_id across replicas, and the
+        # trace CLI stitches the per-replica records by (id, replica)
+        replica = getattr(req, "replica", None)
+        if replica:
+            rec["replica"] = str(replica)
         with self._lock:
             self._live[req.id] = rec
         flight = getattr(self.session, "flight", None)
@@ -173,7 +179,10 @@ class RequestTracer:
         rec["last_event"] = ("token", time.time())
         self.session.histogram("serving/itl").add(gap_s)
         n = self.token_span_every
-        if n and req.id % n == 0:
+        # externally-supplied ids may be strings; hash keeps the 1-in-N
+        # sampling property without constraining the id type
+        rid = req.id if isinstance(req.id, int) else abs(hash(req.id))
+        if n and rid % n == 0:
             recorder = self._recorder()
             if recorder is not None:
                 recorder.emit("serving/decode_token",

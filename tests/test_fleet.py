@@ -1,0 +1,683 @@
+"""Fleet observability plane (accelerate_tpu/telemetry/fleet.py).
+
+The contracts of record:
+- the hardened exposition parser round-trips the exporter's own output
+  and never raises on torn/hostile input;
+- the replica health state machine walks starting → healthy → degraded →
+  draining → unreachable → dead off scrape success, staleness age, and
+  the replica's own gauges, with an ordered transition event log;
+- fleet merges conserve monotone counters across a replica loss, and
+  fleet latency quantiles come from EXACT log-bucket histogram merges
+  (vs numpy on the concatenated samples), never averaged percentiles;
+- `load_score` is monotone in queue depth / free pages / recent ITL and
+  `placement_view()` re-ranks accordingly, dropping a dead replica
+  within one poll;
+- the multi-replica drill: live scrape servers under one collector,
+  kill one mid-burst → `fleet/replica_down` walks pending → firing,
+  token counters stay conserved, placement re-ranks. (2 in-process
+  replicas in tier-1; the 3-subprocess variant is marked slow.)
+
+Everything here is jax-free — the same property the import locks assert.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.telemetry.exporter import ScrapeServer, prometheus_text
+from accelerate_tpu.telemetry.fleet import (
+    DEAD,
+    DEGRADED,
+    DRAINING,
+    DRAINING_PENALTY,
+    HEALTHY,
+    STARTING,
+    UNREACHABLE,
+    ExpositionSnapshot,
+    FleetCollector,
+    load_fleet,
+    load_score,
+    load_score_from_gauges,
+    merge_gauges,
+    merge_histograms,
+    merge_policy,
+    parse_exposition,
+    unflatten_key,
+)
+from accelerate_tpu.telemetry.histograms import StreamingHistogram
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class StubReplicaSession:
+    """The minimal scrape-able replica: rollup gauges + native SLO
+    histograms — exactly what ScrapeServer renders, no engine, no jax."""
+
+    def __init__(self, **gauges):
+        self.hists = {"serving/itl": StreamingHistogram()}
+        self.alerts = None
+        self.last_sample_unix_s = time.time()
+        self.gauges = {
+            "serving/queue_depth": 0,
+            "serving/slot_occupancy": 0.0,
+            "serving/num_slots": 4,
+            "serving/free_slots": 4,
+            "serving/generated_tokens": 0,
+            "serving/requests_completed": 0,
+            "serving/tokens_per_s": 100.0,
+            "serving/load_score": 0.0,
+        }
+        self.gauges.update(gauges)
+
+    def rollup(self):
+        return dict(self.gauges)
+
+    def touch(self):
+        self.last_sample_unix_s = time.time()
+
+
+class TestExpositionParser:
+    def test_tolerates_nan_inf_and_torn_lines(self):
+        text = (
+            "att_ok 1.5\n"
+            "att_dropme NaN\n"
+            "att_posinf +Inf\n"
+            "att_neginf -Inf\n"
+            "att_torn_no_value\n"
+            "att_torn 1.2.3\n"
+            "att_half_writ"  # mid-write torn tail, no newline
+        )
+        snap = parse_exposition(text)
+        assert snap.gauges["ok"] == 1.5
+        assert "dropme" not in snap.gauges  # NaN poisons merges: dropped
+        assert snap.gauges["posinf"] == float("inf")
+        assert snap.gauges["neginf"] == float("-inf")
+        assert "torn" not in snap.gauges
+        assert snap.skipped_lines >= 2
+
+    def test_escaped_and_hostile_label_values(self):
+        text = (
+            'att_alert_firing{rule="plain"} 1\n'
+            'att_alert_firing{rule="with \\"quotes\\" and \\\\slash"} 0\n'
+            'att_alert_firing{rule="brace}inside"} 1\n'
+            'att_alert_firing{rule="new\\nline"} 0\n'
+        )
+        snap = parse_exposition(text)
+        assert snap.alerts == {
+            "plain": 1,
+            'with "quotes" and \\slash': 0,
+            "brace}inside": 1,
+            "new\nline": 0,
+        }
+
+    def test_histogram_buckets_parse_and_rebuild(self):
+        h = StreamingHistogram()
+        samples = [0.001, 0.004, 0.02, 0.02, 0.5]
+        for v in samples:
+            h.add(v)
+        sess = StubReplicaSession()
+        sess.hists = {"serving/ttft": h}
+        snap = parse_exposition(prometheus_text(sess))
+        data = snap.histograms["serving_ttft"]
+        assert data["count"] == len(samples)
+        assert data["sum"] == pytest.approx(sum(samples))
+        rebuilt = StreamingHistogram.from_cumulative(
+            data["buckets"], sum_value=data["sum"]
+        )
+        assert rebuilt.counts == h.counts
+        assert rebuilt.count == h.count
+
+    def test_timestamped_lines_parse(self):
+        snap = parse_exposition("att_x 2.0 1700000000\n")
+        assert snap.gauges["x"] == 2.0
+
+    def test_unflatten_restores_known_namespaces(self):
+        assert unflatten_key("serving_itl_recent_p99_ms") == "serving/itl_recent_p99_ms"
+        assert unflatten_key("usage_acme_decode_tokens") == "usage/acme_decode_tokens"
+        assert unflatten_key("serving/already") == "serving/already"
+        assert unflatten_key("unknown_ns_key") == "unknown_ns_key"
+
+
+class TestLoadScore:
+    def test_monotone_in_every_component(self):
+        base = dict(queue_depth=2, num_slots=4, slot_occupancy=0.5,
+                    free_pages=10, pages_total=20,
+                    itl_recent_p99_ms=20.0, itl_slo_ms=25.0)
+        s0 = load_score(**base)
+        assert load_score(**{**base, "queue_depth": 3}) > s0
+        assert load_score(**{**base, "slot_occupancy": 0.75}) > s0
+        assert load_score(**{**base, "free_pages": 5}) > s0
+        assert load_score(**{**base, "itl_recent_p99_ms": 40.0}) > s0
+        assert load_score(**{**base, "draining": True}) >= s0 + DRAINING_PENALTY
+
+    def test_from_gauges_prefers_exported_score_then_recomputes(self):
+        assert load_score_from_gauges({"serving/load_score": 3.25}) == 3.25
+        g = {"serving/queue_depth": 4, "serving/num_slots": 4,
+             "serving/slot_occupancy": 1.0}
+        assert load_score_from_gauges(g) == pytest.approx(2.0)
+        assert load_score_from_gauges({"unrelated": 1.0}) is None
+
+
+class TestMergePolicy:
+    def test_policy_table(self):
+        assert merge_policy("serving/generated_tokens") == "sum_counter"
+        assert merge_policy("usage/acme_decode_tokens") == "sum_counter"
+        assert merge_policy("serving/ttft_count") == "sum_counter"
+        assert merge_policy("serving/queue_depth") == "sum_live"
+        assert merge_policy("serving/pages_total") == "sum_live"
+        assert merge_policy("serving/slot_occupancy") == "mean"
+        assert merge_policy("serving/prefix_hit_ratio") == "mean"
+        assert merge_policy("serving/itl_p99_ms") == "max"
+        assert merge_policy("scrape_age_seconds") == "max"
+
+    def test_counters_conserve_across_dead_replica(self):
+        a = {"serving/generated_tokens": 40, "serving/queue_depth": 2,
+             "serving/slot_occupancy": 0.5}
+        b = {"serving/generated_tokens": 2, "serving/queue_depth": 7,
+             "serving/slot_occupancy": 1.0}
+        both = merge_gauges([(a, True), (b, True)])
+        assert both["serving/generated_tokens"] == 42
+        assert both["serving/queue_depth"] == 9
+        assert both["serving/slot_occupancy"] == pytest.approx(0.75)
+        b_dead = merge_gauges([(a, True), (b, False)])
+        # the counter keeps the victim's last-known contribution...
+        assert b_dead["serving/generated_tokens"] == 42
+        # ...while instantaneous gauges only count reachable replicas
+        assert b_dead["serving/queue_depth"] == 2
+        assert b_dead["serving/slot_occupancy"] == pytest.approx(0.5)
+
+
+class TestHistogramMerge:
+    def test_layout_mismatch_raises(self):
+        a = StreamingHistogram(growth=1.25)
+        b = StreamingHistogram(growth=1.5)
+        a.add(0.1)
+        b.add(0.1)
+        with pytest.raises(ValueError, match="layouts differ"):
+            a.merge(b)
+        with pytest.raises(ValueError, match="layouts differ"):
+            StreamingHistogram(lo=1e-3).merge(StreamingHistogram(lo=1e-6))
+
+    def test_from_cumulative_rejects_off_grid_edges(self):
+        with pytest.raises(ValueError, match="grid"):
+            StreamingHistogram.from_cumulative([(0.0123, 3)])
+
+    def test_merge_matches_numpy_on_concatenated_samples(self):
+        """The fleet-quantile contract: merging per-replica histograms
+        equals histogramming the union of all samples, and both sit
+        within the ~12% log-bucket error of numpy's exact quantiles."""
+        rng = np.random.RandomState(0)
+        shards = [
+            rng.lognormal(mean=-4.0, sigma=0.8, size=400),   # ~fast replica
+            rng.lognormal(mean=-3.0, sigma=0.5, size=300),   # ~slower
+            rng.lognormal(mean=-2.5, sigma=0.3, size=50),    # ~tail-heavy
+        ]
+        merged = StreamingHistogram()
+        direct = StreamingHistogram()
+        for shard in shards:
+            h = StreamingHistogram()
+            for v in shard:
+                h.add(float(v))
+                direct.add(float(v))
+            merged.merge(h)
+        everything = np.concatenate(shards)
+        assert merged.count == direct.count == everything.size
+        assert merged.sum == pytest.approx(float(everything.sum()))
+        for q in (0.5, 0.95, 0.99):
+            exact = float(np.quantile(everything, q))
+            est = merged.quantile(q)
+            assert est == direct.quantile(q)
+            assert abs(est - exact) / exact < 0.13, (q, est, exact)
+
+    def test_merged_quantile_is_not_average_of_percentiles(self):
+        """A bimodal fleet: averaging per-replica p99s lands nowhere near
+        the true fleet p99; the bucket merge nails it."""
+        fast, slow = StreamingHistogram(), StreamingHistogram()
+        for _ in range(900):
+            fast.add(0.001)
+        for _ in range(100):
+            slow.add(0.1)
+        avg_of_p99 = (fast.quantile(0.99) + slow.quantile(0.99)) / 2
+        merged = StreamingHistogram()
+        merged.merge(fast)
+        merged.merge(slow)
+        true_p99 = float(np.quantile([0.001] * 900 + [0.1] * 100, 0.99))
+        assert abs(merged.quantile(0.99) - true_p99) / true_p99 < 0.13
+        assert abs(avg_of_p99 - true_p99) / true_p99 > 0.4  # the wrong way
+
+    def test_merge_histograms_skips_misaligned_layouts(self):
+        good = {"serving_itl": {"buckets": [(1.25e-6, 3)], "sum": 3e-6}}
+        bad = {"serving_itl": {"buckets": [(0.0123, 5)], "sum": 0.06}}
+        merged = merge_histograms([good, bad, good])
+        assert merged["serving_itl"].count == 6
+
+
+class _ScriptedFetch:
+    """fetch_fn for deterministic state-machine tests: per-target queues
+    of snapshots / exceptions."""
+
+    def __init__(self):
+        self.replies = {}
+
+    def set(self, target, reply):
+        self.replies[target] = reply
+
+    def __call__(self, target):
+        reply = self.replies[target]
+        if isinstance(reply, Exception):
+            raise reply
+        return reply
+
+
+def _snap(gauges):
+    s = ExpositionSnapshot()
+    s.gauges = dict(gauges)
+    return s
+
+
+class TestHealthStateMachine:
+    def _collector(self, tmp_path=None, **kw):
+        fetch = _ScriptedFetch()
+        clock = {"t": 0.0}
+        kw.setdefault("stale_after_s", 5.0)
+        kw.setdefault("dead_after_s", 10.0)
+        c = FleetCollector(
+            [("A", "a"), ("B", "b")], fetch_fn=fetch,
+            clock=lambda: clock["t"],
+            log_dir=str(tmp_path) if tmp_path else None, **kw,
+        )
+        return c, fetch, clock
+
+    def test_full_walk_and_event_log(self, tmp_path):
+        c, fetch, clock = self._collector(tmp_path)
+        ok = {"serving_queue_depth": 1, "serving_load_score": 0.5,
+              "scrape_age_seconds": 0.1}
+        fetch.set("a", _snap(ok))
+        fetch.set("b", _snap(ok))
+        assert {r.state for r in c.replicas.values()} == {STARTING}
+        c.poll_once(now=1.0)
+        assert c.replicas["A"].state == HEALTHY
+        # degraded: endpoint answers, session behind it stopped sampling
+        fetch.set("a", _snap({**ok, "scrape_age_seconds": 30.0}))
+        c.poll_once(now=2.0)
+        assert c.replicas["A"].state == DEGRADED
+        # draining gauge wins over freshness
+        fetch.set("a", _snap({**ok, "serving_draining": 1.0}))
+        c.poll_once(now=3.0)
+        assert c.replicas["A"].state == DRAINING
+        # scrape failure -> unreachable; long enough -> dead
+        fetch.set("a", OSError("connection refused"))
+        c.poll_once(now=4.0)
+        assert c.replicas["A"].state == UNREACHABLE
+        c.poll_once(now=14.0)
+        assert c.replicas["A"].state == DEAD
+        # resurrection is allowed and logged
+        fetch.set("a", _snap(ok))
+        c.poll_once(now=15.0)
+        assert c.replicas["A"].state == HEALTHY
+        walked = [(e["from"], e["to"]) for e in c.events if e["replica"] == "A"]
+        assert walked == [
+            (STARTING, HEALTHY), (HEALTHY, DEGRADED), (DEGRADED, DRAINING),
+            (DRAINING, UNREACHABLE), (UNREACHABLE, DEAD), (DEAD, HEALTHY),
+        ]
+        c.close()
+        # the transition log persists, ordered, one JSON object per line
+        lines = [json.loads(l) for l in
+                 open(tmp_path / "fleet-events.jsonl") if l.strip()]
+        stamps = [e["t_unix_s"] for e in lines]
+        assert stamps == sorted(stamps)
+        assert [  # same walk on disk
+            (e["from"], e["to"]) for e in lines if e["replica"] == "A"
+        ] == walked
+
+    def test_never_up_replica_goes_dead_not_unreachable(self):
+        c, fetch, clock = self._collector(dead_after_s=5.0)
+        fetch.set("a", OSError("refused"))
+        fetch.set("b", OSError("refused"))
+        c.poll_once(now=1.0)
+        # never answered: "not up yet", not "down"
+        assert c.replicas["A"].state == STARTING
+        c.poll_once(now=20.0)
+        assert c.replicas["A"].state == DEAD
+        reasons = [e["reason"] for e in c.events if e["to"] == DEAD]
+        assert all("dead_after_s" in r for r in reasons)
+
+    def test_replica_down_rule_walks_pending_then_firing(self):
+        c, fetch, clock = self._collector(replica_down_for_s=1.5)
+        ok = _snap({"serving_queue_depth": 0, "serving_load_score": 0.1})
+        fetch.set("a", ok)
+        fetch.set("b", ok)
+        c.poll_once(now=1.0)
+        assert c.alerts.states_snapshot()["fleet/replica_down"]["state"] == "ok"
+        fetch.set("b", OSError("killed"))
+        c.poll_once(now=2.0)
+        assert c.alerts.states_snapshot()["fleet/replica_down"]["state"] == "pending"
+        c.poll_once(now=4.0)
+        assert c.alerts.states_snapshot()["fleet/replica_down"]["state"] == "firing"
+        # recovery resolves
+        fetch.set("b", ok)
+        c.poll_once(now=5.0)
+        states = [e["state"] for e in c.alerts.events
+                  if e["rule"] == "fleet/replica_down"]
+        assert states == ["pending", "firing", "resolved"]
+
+    def test_placement_reranks_monotonically_under_perturbation(self):
+        """The acceptance contract: perturb queue depth, free pages, and
+        recent ITL one at a time — the ranking must move against the
+        perturbed replica every time."""
+        c, fetch, clock = self._collector()
+        base = {"serving_queue_depth": 1, "serving_num_slots": 4,
+                "serving_slot_occupancy": 0.25, "serving_free_pages": 30,
+                "serving_pages_total": 40, "serving_itl_recent_p99_ms": 10.0}
+
+        def publish(a_over, b_over, now):
+            ga = {**base, **a_over}
+            gb = {**base, **b_over}
+            for g in (ga, gb):
+                g["serving_load_score"] = load_score(
+                    queue_depth=g["serving_queue_depth"],
+                    num_slots=g["serving_num_slots"],
+                    slot_occupancy=g["serving_slot_occupancy"],
+                    free_pages=g["serving_free_pages"],
+                    pages_total=g["serving_pages_total"],
+                    itl_recent_p99_ms=g["serving_itl_recent_p99_ms"],
+                )
+            fetch.set("a", _snap(ga))
+            fetch.set("b", _snap(gb))
+            c.poll_once(now=now)
+            return [r["replica"] for r in c.placement_view()]
+
+        assert publish({}, {"serving_queue_depth": 5}, 1.0) == ["A", "B"]
+        assert publish({"serving_queue_depth": 9}, {}, 2.0) == ["B", "A"]
+        assert publish({"serving_free_pages": 2}, {}, 3.0) == ["B", "A"]
+        assert publish({}, {"serving_itl_recent_p99_ms": 80.0}, 4.0) == ["A", "B"]
+        # a draining replica is unplaceable no matter how idle
+        assert publish({"serving_draining": 1.0}, {}, 5.0) == ["B"]
+        rows = c.placement_view(include_unplaceable=True)
+        assert [r["replica"] for r in rows] == ["B", "A"]
+        assert rows[1]["placeable"] is False
+
+    def test_offline_dir_target(self, tmp_path):
+        """Artifact-dir replicas: the timeline tail is the snapshot and
+        freshness comes from the last sample's age."""
+        from accelerate_tpu.telemetry.timeline import Timeline
+
+        d = tmp_path / "replica0"
+        d.mkdir()
+        tl = Timeline()
+        tl.add_sample({"serving/queue_depth": 3.0,
+                       "serving/load_score": 1.5}, now=1000.0)
+        tl.flush_jsonl(str(d / "timeline-host0.jsonl"))
+        c = FleetCollector([("R", str(d))], clock=lambda: 1002.0,
+                           stale_after_s=10.0)
+        c.poll_once(now=1002.0)
+        assert c.replicas["R"].state == HEALTHY
+        assert c.replicas["R"].gauges["serving/queue_depth"] == 3.0
+        view = c.placement_view()
+        assert view and view[0]["load_score"] == 1.5
+        # much later the same artifacts read as a stale (degraded) replica
+        c2 = FleetCollector([("R", str(d))], clock=lambda: 2000.0,
+                            stale_after_s=10.0)
+        c2.poll_once(now=2000.0)
+        assert c2.replicas["R"].state == DEGRADED
+
+
+class TestFleetDrillTwoReplicas:
+    """Tier-1 fast variant of the multi-replica drill: two in-process
+    scrape servers under one collector; one dies mid-burst."""
+
+    def test_kill_mid_burst_conserves_counters_and_reranks(self, tmp_path):
+        sessions = {
+            "A": StubReplicaSession(**{"serving/load_score": 0.5}),
+            "B": StubReplicaSession(**{"serving/load_score": 0.2}),
+        }
+        servers = {k: ScrapeServer(s, port=0) for k, s in sessions.items()}
+        assert all(srv.port for srv in servers.values())
+        clock = {"t": 1000.0}
+        c = FleetCollector(
+            [(k, f"http://127.0.0.1:{srv.port}/metrics")
+             for k, srv in servers.items()],
+            clock=lambda: clock["t"], dead_after_s=5.0,
+            replica_down_for_s=1.0, log_dir=str(tmp_path),
+        )
+        try:
+            def burst(step):
+                for name, s in sessions.items():
+                    s.gauges["serving/generated_tokens"] += 10 if name == "A" else 7
+                    s.hists["serving/itl"].add(0.004 if name == "A" else 0.05)
+                    s.touch()
+
+            for i in range(3):
+                burst(i)
+                clock["t"] += 1.0
+                c.poll_once()
+            m = c.fleet_gauges()
+            assert m["fleet/replicas_healthy"] == 2
+            assert m["serving/generated_tokens"] == 3 * 10 + 3 * 7
+            # B advertises the lower load score -> ranked first
+            assert [r["replica"] for r in c.placement_view()] == ["B", "A"]
+
+            # exact fleet quantile: merged buckets == one histogram over
+            # the union of both replicas' samples (within the 12% bound)
+            direct = StreamingHistogram()
+            for s in sessions.values():
+                direct.merge(s.hists["serving/itl"])
+            assert m["serving/itl_p99_ms"] == pytest.approx(
+                direct.quantile(0.99) * 1e3, rel=0.12
+            )
+            assert m["serving/itl_count"] == direct.count
+
+            # kill B mid-burst
+            b_last = sessions["B"].gauges["serving/generated_tokens"]
+            servers["B"].close()
+            burst(3)
+            clock["t"] += 1.0
+            c.poll_once()
+            # placement dropped the victim within one poll
+            assert [r["replica"] for r in c.placement_view()] == ["A"]
+            assert c.replicas["B"].state == UNREACHABLE
+            st = c.alerts.states_snapshot()["fleet/replica_down"]
+            assert st["state"] == "pending"
+            clock["t"] += 2.0
+            c.poll_once()
+            assert c.alerts.states_snapshot()["fleet/replica_down"]["state"] == "firing"
+            clock["t"] += 4.0
+            c.poll_once()
+            assert c.replicas["B"].state == DEAD
+
+            # token conservation: the fleet counter reconciles exactly as
+            # the survivor's live value plus the victim's last scrape
+            m = c.fleet_gauges()
+            a_now = sessions["A"].gauges["serving/generated_tokens"]
+            assert m["serving/generated_tokens"] == a_now + b_last
+            states = [e["state"] for e in c.alerts.events
+                      if e["rule"] == "fleet/replica_down"]
+            assert states == ["pending", "firing"]
+
+            # snapshot -> report fleet section renders the drill
+            c.write_snapshot()
+            data = load_fleet(str(tmp_path))
+            assert data["replicas"]["B"]["state"] == DEAD
+            assert any(e["to"] == DEAD for e in data["events"])
+            from accelerate_tpu.commands.report import format_report, load_report
+
+            text = format_report(load_report(str(tmp_path)))
+            assert "fleet:" in text and "dead" in text
+            assert "health transitions" in text
+        finally:
+            c.close()
+            for srv in servers.values():
+                srv.close()
+
+    def test_watch_fleet_once_renders_table_and_alerts(self, tmp_path, capsys):
+        import argparse
+
+        session = StubReplicaSession(**{"serving/load_score": 0.7})
+        session.gauges["serving/generated_tokens"] = 5
+        srv = ScrapeServer(session, port=0)
+        try:
+            args = argparse.Namespace(
+                target=f"http://127.0.0.1:{srv.port}/metrics,"
+                       f"http://127.0.0.1:1/metrics",
+                fleet=True, interval=0.1, once=True, series=None,
+                span=600.0, width=16, stale_after=10.0, dead_after=15.0,
+            )
+            from accelerate_tpu.commands.watch import watch_command
+
+            assert watch_command(args) == 0
+            out = capsys.readouterr().out
+            assert "watch --fleet" in out and "2 replicas" in out
+            assert "127.0.0.1" in out
+            # the live replica ranks; the bogus one shows unplaceable
+            assert "healthy" in out
+            assert "starting" in out or "unreachable" in out
+            assert "fleet/replica_down" in out
+        finally:
+            srv.close()
+
+
+REPLICA_SCRIPT = textwrap.dedent("""
+    import json, sys, time
+    sys.path.insert(0, {repo!r})
+    from accelerate_tpu.telemetry.exporter import ScrapeServer
+    from accelerate_tpu.telemetry.histograms import StreamingHistogram
+    from accelerate_tpu.telemetry.fleet import load_score
+
+    class Stub:
+        def __init__(self, name, step):
+            self.hists = {{"serving/itl": StreamingHistogram()}}
+            self.alerts = None
+            self.last_sample_unix_s = time.time()
+            self.step = step
+            self.gauges = {{
+                "serving/queue_depth": 0, "serving/num_slots": 4,
+                "serving/free_slots": 4, "serving/slot_occupancy": 0.0,
+                "serving/generated_tokens": 0,
+                "serving/tokens_per_s": 50.0,
+                "serving/load_score": load_score(num_slots=4),
+            }}
+        def rollup(self):
+            return dict(self.gauges)
+
+    name, step = sys.argv[1], int(sys.argv[2])
+    stub = Stub(name, step)
+    srv = ScrapeServer(stub, port=0)
+    print(json.dumps({{"port": srv.port}}), flush=True)
+    while True:
+        time.sleep(0.02)
+        stub.gauges["serving/generated_tokens"] += step
+        stub.hists["serving/itl"].add(0.004)
+        stub.last_sample_unix_s = time.time()
+""").format(repo=REPO)
+
+
+@pytest.mark.slow
+class TestFleetDrillThreeProcesses:
+    """The full acceptance drill: 3 replica subprocesses with real scrape
+    servers under one collector; SIGKILL one mid-burst."""
+
+    def test_kill_one_of_three(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        procs, ports = {}, {}
+        names = ("r0", "r1", "r2")
+        try:
+            for i, name in enumerate(names):
+                p = subprocess.Popen(
+                    [sys.executable, "-c", REPLICA_SCRIPT, name, str(i + 1)],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True, env=env,
+                )
+                procs[name] = p
+                line = p.stdout.readline()
+                assert line, p.stderr.read()
+                ports[name] = json.loads(line)["port"]
+            c = FleetCollector(
+                [(n, f"http://127.0.0.1:{ports[n]}/metrics") for n in names],
+                dead_after_s=1.0, replica_down_for_s=0.25,
+                stale_after_s=10.0, log_dir=str(tmp_path),
+            )
+            deadline = time.time() + 30.0
+
+            def poll_until(predicate, what):
+                while time.time() < deadline:
+                    c.poll_once()
+                    if predicate():
+                        return
+                    time.sleep(0.1)
+                pytest.fail(f"drill timed out waiting for {what}")
+
+            # burst: all three healthy and counting
+            poll_until(
+                lambda: (c.fleet_gauges().get("fleet/replicas_healthy") == 3
+                         and c.fleet_gauges().get("serving/generated_tokens", 0) > 0),
+                "3 healthy replicas mid-burst",
+            )
+            assert len(c.placement_view()) == 3
+            tokens_before = c.fleet_gauges()["serving/generated_tokens"]
+
+            # SIGKILL the victim mid-burst
+            victim = "r1"
+            procs[victim].kill()
+            procs[victim].wait(timeout=10)
+            poll_until(
+                lambda: c.replicas[victim].state in (UNREACHABLE, DEAD),
+                "victim unreachable",
+            )
+            # placement dropped it within that poll
+            assert victim not in {r["replica"] for r in c.placement_view()}
+            poll_until(lambda: c.replicas[victim].state == DEAD, "victim dead")
+            poll_until(
+                lambda: c.alerts.states_snapshot()["fleet/replica_down"]["state"]
+                == "firing",
+                "fleet/replica_down firing",
+            )
+            states = [e["state"] for e in c.alerts.events
+                      if e["rule"] == "fleet/replica_down"]
+            assert states[:2] == ["pending", "firing"]  # ordered walk
+
+            # conservation: fleet counter never stepped back across the
+            # loss, and reconciles exactly as survivors' live scrapes
+            # plus the victim's last-known scrape
+            c.poll_once()
+            m = c.fleet_gauges()
+            assert m["serving/generated_tokens"] >= tokens_before
+            victim_last = c.replicas[victim].gauges["serving/generated_tokens"]
+            survivors = sum(
+                c.replicas[n].gauges["serving/generated_tokens"]
+                for n in names if n != victim
+            )
+            assert m["serving/generated_tokens"] == survivors + victim_last
+            assert victim_last > 0
+            # survivors keep advancing: a later direct scrape is ahead of
+            # (or equal to) what the collector summed a moment ago
+            for n in names:
+                if n == victim:
+                    continue
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports[n]}/metrics", timeout=5
+                ) as resp:
+                    snap = parse_exposition(resp.read().decode())
+                assert snap.gauges["serving_generated_tokens"] >= (
+                    c.replicas[n].gauges["serving/generated_tokens"]
+                )
+            c.close()
+            events = [json.loads(l) for l in
+                      open(tmp_path / "fleet-events.jsonl") if l.strip()]
+            assert any(e["replica"] == victim and e["to"] == DEAD
+                       for e in events)
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass

@@ -160,3 +160,30 @@ class TestNoEagerHeavyImports:
             "watch.parse_prometheus('att_x 1.0\\n')\n"
             "assert 'jax' not in sys.modules, 'watch CLI pulled jax'"
         )
+
+    def test_fleet_plane_stays_light(self):
+        """The fleet observability plane (collector, health state
+        machine, merge policies, placement view) and the `watch --fleet`
+        rendering path run on a router tier with no accelerator stack —
+        no jax, no flax, no pallas, end to end through a poll."""
+        _probe(
+            "import sys\n"
+            "import accelerate_tpu.telemetry.fleet as fleet\n"
+            "import accelerate_tpu.commands.watch as watch\n"
+            "snap = fleet.parse_exposition(\n"
+            "    'att_serving_queue_depth 2\\natt_bad NaN\\ntorn line here')\n"
+            "assert snap.gauges['serving_queue_depth'] == 2\n"
+            "assert fleet.load_score(queue_depth=4, num_slots=4) == 1.0\n"
+            "c = fleet.FleetCollector(\n"
+            "    [('A', 'http://a/metrics')], clock=lambda: 1.0,\n"
+            "    fetch_fn=lambda t: 'att_serving_load_score 0.5\\n'\n"
+            "                       'att_serving_queue_depth 1\\n')\n"
+            "c.poll_once(now=1.0)\n"
+            "view = c.placement_view()\n"
+            "assert view and view[0]['load_score'] == 0.5\n"
+            "watch.render_fleet_frame(c, ['serving/queue_depth'])\n"
+            "heavy = {m for m in ('jax', 'flax') if m in sys.modules}\n"
+            "assert not heavy, f'fleet plane import pulled {heavy}'\n"
+            "bad = sorted(m for m in sys.modules if 'pallas' in m)\n"
+            "assert not bad, f'fleet plane pulled pallas: {bad}'"
+        )

@@ -427,3 +427,174 @@ class TestTelemetryIntegration:
             assert rollup["sys/window_steps"] >= 1
         finally:
             session.close()
+
+
+class TestPlacementSignalContract:
+    """serving/load_score — the stable router contract (telemetry/fleet.py,
+    docs/telemetry.md "Fleet view"): every engine exports one comparable
+    scalar plus its raw components, and perturbing queue depth / slot
+    occupancy / recent ITL / drain moves the score monotonically."""
+
+    def test_every_engine_exports_score_and_components(self, served_model):
+        model, cfg, params, prompts = served_model
+        engine = ServingEngine(
+            model, params, num_slots=2, max_cache_len=64, prefill_chunks=(8,)
+        )
+        m = engine.metrics()
+        assert m["serving/num_slots"] == 2
+        assert m["serving/free_slots"] == 2
+        assert m["serving/load_score"] == 0.0  # idle engine: nothing queued
+
+    def test_score_moves_monotonically_under_perturbation(self, served_model):
+        from accelerate_tpu.telemetry.fleet import DRAINING_PENALTY
+
+        model, cfg, params, prompts = served_model
+        engine = ServingEngine(
+            model, params, num_slots=2, max_cache_len=64, prefill_chunks=(8,)
+        )
+        idle = engine.metrics()["serving/load_score"]
+        # queue depth: submitted-but-not-run requests raise the score
+        reqs = [engine.submit(p, max_new_tokens=2, seed=i)
+                for i, p in enumerate(prompts[:3])]
+        queued = engine.metrics()["serving/load_score"]
+        assert queued > idle
+        assert engine.metrics()["serving/queue_depth"] == 3
+        # recent ITL p99: a latency regression raises it further
+        engine._itl.extend([0.5] * 16)
+        engine._itl_emitted += 16
+        slow = engine.metrics()["serving/load_score"]
+        assert slow > queued
+        # drain: the score jumps past anything a live replica can reach
+        engine.request_drain()
+        draining = engine.metrics()["serving/load_score"]
+        assert draining >= slow + DRAINING_PENALTY
+        assert engine.metrics()["serving/draining"] is True
+        # drain still gives every queued request a definite outcome
+        engine.run()
+        assert all(r.outcome in ("finished", "shed") for r in reqs)
+        assert engine.metrics()["serving/free_slots"] == 2
+
+    def test_score_rides_rollup_and_exposition(self, served_model, tmp_path):
+        from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+        from accelerate_tpu.telemetry.fleet import parse_exposition
+
+        model, cfg, params, prompts = served_model
+        session = TelemetrySession(
+            TelemetryConfig(trace_dir=str(tmp_path), spans=False, watchdog=False)
+        )
+        try:
+            engine = ServingEngine(
+                model, params, num_slots=2, max_cache_len=64,
+                prefill_chunks=(8,), telemetry=session,
+            )
+            engine.generate_batched(prompts[:2], max_new_tokens=2)
+            rollup = session.rollup()
+            assert "serving/load_score" in rollup
+            assert rollup["serving/free_slots"] == 2
+            snap = parse_exposition(prometheus_text(session))
+            assert "serving_load_score" in snap.gauges
+            assert snap.gauges["serving_num_slots"] == 2.0
+        finally:
+            session.close()
+
+
+class TestTraceStitching:
+    """submit(request_id=...) + the replica field: a router re-queuing one
+    logical request across replicas leaves per-replica records the trace
+    CLI stitches into one hop-by-hop timeline."""
+
+    def test_external_request_id_and_replica_land_in_records(
+        self, served_model, tmp_path
+    ):
+        import json as json_mod
+
+        from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession
+
+        model, cfg, params, prompts = served_model
+        session = TelemetrySession(TelemetryConfig(
+            trace_dir=str(tmp_path), spans=False, watchdog=False,
+        ))
+        try:
+            engine = ServingEngine(
+                model, params, num_slots=2, max_cache_len=64,
+                prefill_chunks=(8,), telemetry=session, replica="replica-a",
+            )
+            assert engine.replica == "replica-a"
+            req = engine.submit(prompts[0], max_new_tokens=2,
+                                request_id="router-7")
+            assert req.id == "router-7"
+            auto = engine.submit(prompts[1], max_new_tokens=2)
+            assert isinstance(auto.id, int)
+            engine.run()
+            session.close()
+            recs = {r["request_id"]: r for r in (
+                json_mod.loads(l)
+                for l in open(tmp_path / "requests-host0.jsonl")
+            )}
+            assert recs["router-7"]["replica"] == "replica-a"
+            assert recs["router-7"]["tokens"] == 2
+            assert recs[auto.id]["replica"] == "replica-a"
+        finally:
+            session.close()
+
+    def test_requeued_request_stitches_across_two_replicas(
+        self, served_model, tmp_path
+    ):
+        """Two engines = two replicas, each with its own telemetry dir;
+        the same external id submitted to both (the re-queue) stitches
+        into an ordered 2-hop timeline."""
+        from accelerate_tpu.commands.trace import (
+            load_requests,
+            stitch_request,
+        )
+        from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession
+
+        model, cfg, params, prompts = served_model
+        dirs = []
+        for name in ("replica-a", "replica-b"):
+            d = tmp_path / name
+            d.mkdir()
+            dirs.append(str(d))
+            session = TelemetrySession(TelemetryConfig(
+                trace_dir=str(d), spans=False, watchdog=False,
+            ))
+            try:
+                engine = ServingEngine(
+                    model, params, num_slots=1, max_cache_len=64,
+                    prefill_chunks=(8,), telemetry=session, replica=name,
+                )
+                engine.submit(prompts[0], max_new_tokens=2,
+                              request_id="req-42")
+                engine.run()
+            finally:
+                session.close()
+
+        records = load_requests(dirs)
+        hops = [r for r in records if r["request_id"] == "req-42"]
+        assert len(hops) == 2
+        stitched = stitch_request(hops)
+        assert stitched["hop_count"] == 2
+        assert [h["replica"] for h in stitched["hops"]] == [
+            "replica-a", "replica-b"
+        ]
+        assert stitched["tokens"] == 4
+        assert stitched["hops"][1]["gap_ms"] is not None
+        assert stitched["end_to_end_ms"] > 0
+
+        # and through the CLI: summary over both dirs renders the hops
+        import argparse
+        import io
+        import json as json_mod
+        from contextlib import redirect_stdout
+
+        from accelerate_tpu.commands.trace import trace_command
+
+        args = argparse.Namespace(
+            trace_cmd="summary", target=dirs, request_id="req-42", json=True
+        )
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert trace_command(args) == 0
+        out = json_mod.loads(buf.getvalue())
+        assert out["stitched"]["hop_count"] == 2

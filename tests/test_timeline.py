@@ -7,6 +7,7 @@ import json
 import logging
 import os
 import socket
+import time
 
 import numpy as np
 import pytest
@@ -511,6 +512,189 @@ class TestExporterHardening:
             "a wedged scrape thread would hold the process open"
         )
         assert srv.server is None
+
+    def test_slow_client_does_not_serialize_concurrent_scrapes(self):
+        """One wedged fleet poller (connects, never sends the request)
+        must not block the on-call's manual curl: scrapes are served on
+        per-request threads, so a concurrent fetch completes while the
+        slow client is still dangling."""
+        from accelerate_tpu.telemetry.exporter import ScrapeServer
+
+        srv = ScrapeServer(self._fake_session({"x": 1.0}), port=0)
+        wedged = socket.socket()
+        try:
+            wedged.connect(("127.0.0.1", srv.port))
+            # half a request line, then silence: the handler thread for
+            # this client is now blocked reading
+            wedged.sendall(b"GET /metr")
+            import urllib.request
+
+            t0 = time.perf_counter()
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+            ).read().decode()
+            assert "att_x 1.0" in body
+            assert time.perf_counter() - t0 < 5.0
+        finally:
+            wedged.close()
+            srv.close()
+
+    def test_scrape_age_gauge_tracks_session_freshness(self):
+        """att_scrape_age_seconds: the collector's frozen-gauge-vs-frozen
+        -replica discriminator — present when the session carries a
+        sample clock, growing while that clock is frozen."""
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+
+        s = self._fake_session({"x": 1.0})
+        assert "att_scrape_age_seconds" not in prometheus_text(s)
+        s.last_sample_unix_s = time.time()
+        text = prometheus_text(s)
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("att_scrape_age_seconds ")][0]
+        assert 0.0 <= float(line.split()[1]) < 5.0
+        s.last_sample_unix_s = time.time() - 120.0  # frozen sampler
+        line = [ln for ln in prometheus_text(s).splitlines()
+                if ln.startswith("att_scrape_age_seconds ")][0]
+        assert float(line.split()[1]) > 100.0
+
+    def test_session_sample_timeline_advances_freshness_clock(self):
+        from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession
+
+        session = TelemetrySession(TelemetryConfig(
+            timeline=True, timeline_interval_s=0, watchdog=False,
+            flight_recorder=False, spans=False,
+        ))
+        try:
+            # None until the first sample: a session whose sampler never
+            # runs must not export an age that only ever grows
+            assert session.last_sample_unix_s is None
+            t0 = time.time()
+            session.sample_timeline(now=123.0)  # fake `now` ...
+            # ... but freshness is wall-clock: it answers "when did this
+            # session last actually sample", not what it stamped
+            assert session.last_sample_unix_s >= t0
+        finally:
+            session.close()
+        # a timeline-less session never exports the age gauge at all —
+        # a fleet collector must not mark it degraded for a sampler it
+        # was never configured to run
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+
+        bare = TelemetrySession(TelemetryConfig(
+            timeline=False, watchdog=False, flight_recorder=False,
+            spans=False,
+        ))
+        try:
+            assert bare.last_sample_unix_s is None
+            assert "att_scrape_age_seconds" not in prometheus_text(bare)
+        finally:
+            bare.close()
+
+
+class TestExpositionRoundTrip:
+    """The watch/FleetCollector parser against the exporter's own output:
+    render_prometheus -> parse -> the same gauges (the satellite's
+    round-trip property), plus hostile-input tolerance."""
+
+    def _session(self, values, alerts=None, hists=None):
+        class S:
+            pass
+
+        s = S()
+        s.rollup = lambda: values
+        s.hists = hists or {}
+        if alerts is not None:
+            class A:
+                def states_snapshot(self):
+                    return alerts
+
+            s.alerts = A()
+        return s
+
+    def test_gauges_round_trip_exactly(self):
+        from accelerate_tpu.commands.watch import parse_prometheus
+        from accelerate_tpu.telemetry.exporter import _metric_name, prometheus_text
+
+        values = {
+            "serving/tokens_per_s": 1234.5678,
+            "serving/queue_depth": 0,
+            "goodput/goodput_frac": 0.875,
+            "usage/acme_corp/decode_tokens": 99,
+            "exe/decode:v2_mfu": 61.25,
+            "odd value": -0.001,
+            "big": 1.5e18,
+            "tiny": 7e-12,
+        }
+        gauges, alerts = parse_prometheus(prometheus_text(self._session(values)))
+        assert len(gauges) == len(values)
+        for key, v in values.items():
+            flat = _metric_name(key)[len("att_"):]
+            assert gauges[flat] == float(v), key
+        assert alerts == {}
+
+    def test_round_trip_with_specials_nan_dropped_inf_kept(self):
+        from accelerate_tpu.commands.watch import parse_prometheus
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+
+        gauges, _ = parse_prometheus(prometheus_text(self._session({
+            "fine": 2.0,
+            "nan_gauge": float("nan"),
+            "inf_gauge": float("inf"),
+            "ninf_gauge": float("-inf"),
+        })))
+        assert gauges["fine"] == 2.0
+        assert "nan_gauge" not in gauges  # NaN would poison every merge
+        assert gauges["inf_gauge"] == float("inf")
+        assert gauges["ninf_gauge"] == float("-inf")
+
+    def test_alert_label_escaping_round_trips(self):
+        from accelerate_tpu.commands.watch import parse_prometheus
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+
+        rules = {'we"ird\\rule\n': {"state": "firing"},
+                 "calm}brace": {"state": "ok"}}
+        _, alerts = parse_prometheus(prometheus_text(
+            self._session({}, alerts=rules)
+        ))
+        assert alerts == {'we"ird\\rule\n': 1, "calm}brace": 0}
+
+    def test_torn_scrape_is_tolerated_line_by_line(self):
+        """A scrape racing the writer can cut anywhere: every truncation
+        point must parse without raising and keep every intact line."""
+        from accelerate_tpu.commands.watch import parse_prometheus
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+
+        text = prometheus_text(self._session(
+            {"a": 1.0, "b": 2.0, "c": 3.0},
+            alerts={"r": {"state": "firing"}},
+        ))
+        full_gauges, full_alerts = parse_prometheus(text)
+        for cut in range(0, len(text), 7):
+            gauges, alerts = parse_prometheus(text[:cut])  # never raises
+            assert set(gauges) <= set(full_gauges)
+            assert set(alerts) <= set(full_alerts)
+            for k, v in gauges.items():
+                assert full_gauges[k] == v
+
+    def test_histogram_buckets_round_trip_through_parser(self):
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+        from accelerate_tpu.telemetry.fleet import parse_exposition
+        from accelerate_tpu.telemetry.histograms import StreamingHistogram
+
+        h = StreamingHistogram()
+        for v in (0.002, 0.002, 0.017, 0.3):
+            h.add(v)
+        snap = parse_exposition(prometheus_text(
+            self._session({}, hists={"serving/itl": h})
+        ))
+        rebuilt = StreamingHistogram.from_cumulative(
+            snap.histograms["serving_itl"]["buckets"],
+            sum_value=snap.histograms["serving_itl"]["sum"],
+        )
+        assert rebuilt.counts == h.counts
+        assert rebuilt.sum == pytest.approx(h.sum)
+        # the percentile gauges still parse as plain gauges beside them
+        assert "serving_itl_seconds_p99" in snap.gauges
 
 
 class TestReportDiff:
