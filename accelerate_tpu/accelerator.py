@@ -1065,7 +1065,34 @@ class TrainEngine:
                         pass
             return metrics
 
+        # expose the underlying jitted executable to the static program
+        # auditor (`accelerate-tpu audit`): the runner closure hides it,
+        # and the auditor needs the fn + effective donation set to trace
+        run._audit_fn = jitted
+        run._audit_donate = donate
         return run
+
+    def audit_entrypoints(self, step, batch) -> list:
+        """Entry-point specs for ``accelerate_tpu.analysis.program_audit``
+        covering the fused train step ``build_train_step`` returned:
+        the underlying jitted fn, the live optimizer/param state as
+        example args, and the effective ``donate_argnums``. Trace-only —
+        nothing executes. ``batch`` is one example batch shaped like the
+        real traffic (what the signature forensics fingerprint too)."""
+        import jax as _jax
+
+        fn = getattr(step, "_audit_fn", None)
+        if fn is None:
+            return []
+        donate = tuple(getattr(step, "_audit_donate", ()) or ())
+        return [dict(
+            name="train_step", fn=fn,
+            args=(self.params, self.opt_state, self.extra_state,
+                  self.scale_state, _jax.random.PRNGKey(0), batch),
+            donate=donate, donate_expected=bool(donate),
+            compute_dtype=("bfloat16"
+                           if self.state.mixed_precision == "bf16" else None),
+        )]
 
     def _make_apply(self, extra_state, rng_key):
         def apply_fn(params, *args, **kwargs):
@@ -2007,6 +2034,13 @@ class Accelerator:
         return self._engines[-1].build_train_step(
             loss_fn=loss_fn, micro_steps=micro_steps, steps_per_call=steps_per_call
         )
+
+    def audit_entrypoints(self, step, batch) -> list:
+        """Static-audit specs for a step built by :meth:`build_train_step`
+        (see :meth:`TrainEngine.audit_entrypoints`)."""
+        if not self._engines:
+            return []
+        return self._engines[-1].audit_entrypoints(step, batch)
 
     # ------------------------------------------------------------------
     # collectives façade (reference accelerator.py:2408-2608)

@@ -1,0 +1,204 @@
+"""The findings model every analyzer shares.
+
+A finding is one detected invariant violation — a donation miss in a
+lowered program, a lock-order inversion in host code, a truthy-``"0"``
+env default — carrying a severity and a **stable fingerprint**. The
+fingerprint hashes the check name, the target (an entry-point name or a
+repo-relative file path) and a semantic anchor (the lock pair, the arg
+path, the env var name) but never a line number, so editing unrelated
+code does not churn it.
+
+``Baseline`` is the suppression file (``audit-baseline.json``): findings
+whose fingerprint is baselined — each with a one-line justification the
+CLI renders — are *suppressed*, not gone. ``accelerate-tpu audit`` exits
+non-zero only on unbaselined P1 findings, which is what lets the tier-1
+test gate double as the CI gate.
+
+Stdlib only; jax-free by contract (``analysis.hygiene`` declares it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+# severity model: P1 = a correctness/SLO hazard the repo's invariants
+# forbid (deadlock, silent config change, corrupting donation pattern,
+# host callback in a hot program); P2 = a real cost that is not a
+# correctness hazard (HBM bloat, f32 leak off the matmul path, str/int
+# type confusion); P3 = advisory (coverage gaps, style-level hygiene).
+SEVERITIES = ("P1", "P2", "P3")
+
+
+def fingerprint(check: str, target: str, anchor: str = "") -> str:
+    """Stable 16-hex id of one finding site. ``target`` must be a
+    repo-relative path or an entry-point name (never absolute — two
+    checkouts must agree); ``anchor`` the semantic detail that makes the
+    site unique *without* line numbers."""
+    return hashlib.blake2s(
+        f"{check}|{target}|{anchor}".encode(), digest_size=8
+    ).hexdigest()
+
+
+@dataclass
+class Finding:
+    """One invariant violation. ``detail`` holds the volatile extras
+    (line numbers, byte counts, chains) that inform a human but must not
+    key the fingerprint."""
+
+    check: str
+    severity: str
+    target: str
+    message: str
+    anchor: str = ""
+    detail: dict = field(default_factory=dict)
+    justification: Optional[str] = None  # set when baselined
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity {self.severity!r} not in {SEVERITIES}")
+
+    @property
+    def fingerprint(self) -> str:
+        return fingerprint(self.check, self.target, self.anchor)
+
+    def to_dict(self) -> dict:
+        out = {
+            "check": self.check,
+            "severity": self.severity,
+            "target": self.target,
+            "message": self.message,
+            "anchor": self.anchor,
+            "fingerprint": self.fingerprint,
+        }
+        if self.detail:
+            out["detail"] = self.detail
+        if self.justification is not None:
+            out["justification"] = self.justification
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(
+            check=d["check"], severity=d["severity"], target=d["target"],
+            message=d.get("message", ""), anchor=d.get("anchor", ""),
+            detail=dict(d.get("detail") or {}),
+            justification=d.get("justification"),
+        )
+
+
+def sort_findings(findings: list) -> list:
+    """Severity-major (P1 first), then target/check/anchor for stable
+    output across runs and hosts."""
+    return sorted(
+        findings,
+        key=lambda f: (SEVERITIES.index(f.severity), f.target, f.check, f.anchor),
+    )
+
+
+def summarize(findings: list) -> dict:
+    out = {f"findings_{s.lower()}": 0 for s in SEVERITIES}
+    out["findings_total"] = len(findings)
+    for f in findings:
+        out[f"findings_{f.severity.lower()}"] += 1
+    return out
+
+
+class Baseline:
+    """The checked-in suppression file. Every entry is a fingerprint with
+    a mandatory one-line justification — a baselined finding is a
+    *decision*, and the CLI renders the decision next to the suppression
+    so it can be re-litigated, not forgotten."""
+
+    def __init__(self, entries: Optional[dict] = None, path: Optional[str] = None):
+        self.entries: dict = dict(entries or {})
+        self.path = path
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Baseline":
+        """Missing/empty file -> empty baseline (audit of a fresh tree
+        needs no ceremony); a malformed file raises — a silently-ignored
+        baseline would un-suppress everything and fail CI confusingly."""
+        if not path or not os.path.exists(path):
+            return cls(path=path)
+        with open(path) as fh:
+            data = json.load(fh)
+        entries = data.get("entries") if isinstance(data, dict) else None
+        if not isinstance(entries, dict):
+            raise ValueError(f"{path}: expected {{'entries': {{fingerprint: ...}}}}")
+        for fp, entry in entries.items():
+            if not (isinstance(entry, dict) and entry.get("justification")):
+                raise ValueError(
+                    f"{path}: baseline entry {fp} needs a justification string"
+                )
+        return cls(entries, path=path)
+
+    def save(self, path: Optional[str] = None):
+        path = path or self.path
+        if not path:
+            raise ValueError("no baseline path")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump({"version": 1, "entries": self.entries}, fh, indent=1,
+                      sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, path)
+
+    def add(self, finding: Finding, justification: str):
+        if not justification:
+            raise ValueError("a baselined finding needs a justification")
+        self.entries[finding.fingerprint] = {
+            "check": finding.check,
+            "target": finding.target,
+            "anchor": finding.anchor,
+            "severity": finding.severity,
+            "justification": str(justification),
+        }
+
+    def split(self, findings: list) -> tuple:
+        """(active, suppressed): suppressed findings carry their
+        baseline justification for rendering."""
+        active, suppressed = [], []
+        for f in findings:
+            entry = self.entries.get(f.fingerprint)
+            if entry is None:
+                active.append(f)
+            else:
+                f.justification = entry.get("justification")
+                suppressed.append(f)
+        return active, suppressed
+
+    def stale_entries(self, findings: list) -> dict:
+        """Baseline entries no finding matched this run — candidates for
+        deletion (the violation was fixed but the suppression lingers)."""
+        seen = {f.fingerprint for f in findings}
+        return {fp: e for fp, e in self.entries.items() if fp not in seen}
+
+
+def render_findings(active: list, suppressed: list, *, verbose: bool = True) -> list:
+    """Text lines for the CLI: active findings severity-major, then the
+    suppressed ones with their baseline justifications."""
+    lines = []
+    counts = summarize(active)
+    lines.append(
+        f"{counts['findings_total']} finding(s): "
+        + ", ".join(f"{counts[f'findings_{s.lower()}']} {s}" for s in SEVERITIES)
+        + (f" (+{len(suppressed)} baselined)" if suppressed else "")
+    )
+    for f in sort_findings(active):
+        lines.append(f"  [{f.severity}] {f.check}  {f.target}  ({f.fingerprint})")
+        lines.append(f"       {f.message}")
+        if verbose:
+            for key in ("line", "chain", "bytes", "arg", "lock_order"):
+                if key in f.detail:
+                    lines.append(f"       {key}: {f.detail[key]}")
+    for f in sort_findings(suppressed):
+        lines.append(
+            f"  [baselined {f.severity}] {f.check}  {f.target}  ({f.fingerprint})"
+        )
+        lines.append(f"       {f.message}")
+        lines.append(f"       justification: {f.justification}")
+    return lines

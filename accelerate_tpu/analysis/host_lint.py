@@ -1,0 +1,485 @@
+"""Host-code linter: the deadlock / config-trap classes, caught statically.
+
+The costliest host-side bugs of the last few PRs were all statically
+visible in the AST: an alert action invoked while the manager lock was
+held (a flight dump re-enters ``rollup_keys`` — deadlock, PR 9), and a
+``"0"`` env default that was truthy as a *string* so the quantize pool
+silently pinned to one worker (PR 10). This pass walks the telemetry /
+serving host modules and flags:
+
+- **lock-order inversions** — a cycle in the lock-acquisition-order
+  graph (lock A held while B is acquired in one function, B held while A
+  is acquired in another; one level of intra-module call expansion, so
+  ``with self._lock: self.helper()`` sees the locks ``helper`` takes);
+- **user callbacks invoked under a lock** — ``on_*`` / ``*_callback`` /
+  ``*_hook`` / ``*_fn`` / ``*action*`` callees inside a ``with <lock>:``
+  body (directly or one call level down): a slow or re-entrant callback
+  stalls or deadlocks every other path that needs the lock;
+- **env-var default traps** — ``int(os.environ.get(K, "0")) or d``
+  (an explicit ``"0"`` silently becomes the fallback: int-the-string
+  first, THEN apply the default), ``os.environ.get(K) or 3`` (str when
+  set, int when unset), and ``if os.environ.get(K, "0"):`` (``"0"`` is a
+  truthy string).
+
+Pure stdlib ``ast`` — this module is in the declared jax-free set and a
+tier-1 test asserts the full pass stays under 5 seconds, so it can gate
+CI without an accelerator stack or a jax import.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from .findings import Finding
+
+# the host-code surfaces the lint pass owns by default (device/model code
+# — models/, ops/, parallel/ — is the program auditor's jurisdiction)
+DEFAULT_LINT_PATHS = (
+    "accelerate_tpu/telemetry",
+    "accelerate_tpu/serving",
+    "accelerate_tpu/commands",
+    "accelerate_tpu/utils",
+    "accelerate_tpu/runtime",
+    "accelerate_tpu/analysis",
+)
+
+# callee names that mean "someone else's code runs here": streaming/token
+# callbacks, alert actions, injected hooks/fns. Deliberately name-based —
+# the point is to flag the *convention* so a misnamed internal helper is
+# renamed rather than silently exempted.
+_CALLBACK_RE = re.compile(
+    r"(^on_[a-z0-9_]*$)|(callback)|(_cb$)|(^cb$)|(hook$)|(action$)|(actions$)|(_fn$)"
+)
+
+_LOCKISH_ATTR_RE = re.compile(r"lock", re.IGNORECASE)
+
+
+def _env_get_call(node) -> Optional[ast.Call]:
+    """The ``os.environ.get(...)`` / ``os.getenv(...)`` call inside
+    ``node`` (node itself, not nested), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "getenv" and isinstance(fn.value, ast.Name) and fn.value.id == "os":
+            return node
+        if fn.attr == "get" and isinstance(fn.value, ast.Attribute) \
+                and fn.value.attr == "environ":
+            return node
+    return None
+
+
+def _env_var_name(call: ast.Call) -> str:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return "?"
+
+
+def _env_default(call: ast.Call):
+    """(has_default, value) of the env get's default argument."""
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        return True, call.args[1].value
+    return (len(call.args) >= 2), None
+
+
+def _numeric_cast_of_env(node) -> Optional[ast.Call]:
+    """``int(...)``/``float(...)`` whose argument contains an env get."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("int", "float") and node.args:
+        for sub in ast.walk(node.args[0]):
+            env = _env_get_call(sub)
+            if env is not None:
+                return env
+    return None
+
+
+class _FunctionInfo:
+    __slots__ = ("qualname", "acquires", "edges", "callback_calls", "calls_under")
+
+    def __init__(self, qualname: str):
+        self.qualname = qualname
+        self.acquires: list = []        # (lock_key, line)
+        self.edges: list = []           # (held_key, acquired_key, line)
+        self.callback_calls: list = []  # (held_key_or_None, callee_name, line)
+        self.calls_under: list = []     # (held_key, callee_qualname_guess, line)
+
+
+class _ModuleLint(ast.NodeVisitor):
+    """One pass over a module: lock inventory, per-function acquisition
+    facts, env-default traps."""
+
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.lock_vars: set = set()      # keys assigned from threading.[R]Lock()
+        self.functions: dict = {}        # qualname -> _FunctionInfo
+        self.findings: list = []
+        self._class_stack: list = []
+        self._func_stack: list = []
+        self._held_stack: list = []      # lock keys currently held (lexically)
+        # BoolOps sitting directly inside int()/float() — `int(env or 0)`
+        # is the CORRECT parse-with-fallback idiom, not a type trap
+        self._cast_wrapped: set = set()
+
+    # -- lock identity ------------------------------------------------------
+
+    def _lock_key(self, expr) -> Optional[str]:
+        """Stable key for a lock-ish ``with`` subject: ``Class.attr`` for
+        ``self.attr``, the bare name for module/local locks. An attribute
+        counts when its name smells like a lock OR it was seen assigned
+        from ``threading.Lock()/RLock()``."""
+        cls = self._class_stack[-1] if self._class_stack else ""
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            key = f"{cls}.{expr.attr}" if cls else f"?.{expr.attr}"
+            if key in self.lock_vars or _LOCKISH_ATTR_RE.search(expr.attr):
+                return key
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.lock_vars or _LOCKISH_ATTR_RE.search(expr.id):
+                return expr.id
+            return None
+        return None
+
+    @staticmethod
+    def _is_lock_ctor(node) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("Lock", "RLock")
+        )
+
+    def visit_Assign(self, node):
+        if self._is_lock_ctor(node.value):
+            cls = self._class_stack[-1] if self._class_stack else ""
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    self.lock_vars.add(f"{cls}.{tgt.attr}" if cls else f"?.{tgt.attr}")
+                elif isinstance(tgt, ast.Name):
+                    self.lock_vars.add(tgt.id)
+        self.generic_visit(node)
+
+    # -- scope bookkeeping --------------------------------------------------
+
+    def _qualname(self, name: str) -> str:
+        cls = self._class_stack[-1] if self._class_stack else ""
+        return f"{cls}.{name}" if cls else name
+
+    def visit_ClassDef(self, node):
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_function(self, node):
+        qual = self._qualname(node.name)
+        info = self.functions.setdefault(qual, _FunctionInfo(qual))
+        self._func_stack.append(info)
+        held_save, self._held_stack = self._held_stack, []
+        self.generic_visit(node)
+        self._held_stack = held_save
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # -- with / call facts --------------------------------------------------
+
+    def visit_With(self, node):
+        keys = []
+        for item in node.items:
+            key = self._lock_key(item.context_expr)
+            if key is not None:
+                keys.append(key)
+        info = self._func_stack[-1] if self._func_stack else None
+        for key in keys:
+            if info is not None:
+                info.acquires.append((key, node.lineno))
+                for held in self._held_stack:
+                    if held != key:  # re-entering an RLock is not an edge
+                        info.edges.append((held, key, node.lineno))
+        # context expressions may themselves contain calls/env gets —
+        # visit them BEFORE the body counts as lock-held territory
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars:
+                self.visit(item.optional_vars)
+        self._held_stack.extend(keys)
+        for child in node.body:
+            self.visit(child)
+        if keys:
+            del self._held_stack[-len(keys):]
+
+    @staticmethod
+    def _callee_name(func) -> Optional[str]:
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        if isinstance(func, ast.Name):
+            return func.id
+        return None
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Name) and node.func.id in ("int", "float"):
+            for arg in node.args:
+                if isinstance(arg, ast.BoolOp):
+                    self._cast_wrapped.add(id(arg))
+        info = self._func_stack[-1] if self._func_stack else None
+        name = self._callee_name(node.func)
+        if info is not None and name is not None:
+            held = self._held_stack[-1] if self._held_stack else None
+            if _CALLBACK_RE.search(name):
+                # held=None entries are harmless on their own but become
+                # findings when a caller runs this function under a lock
+                # (one-level expansion below)
+                info.callback_calls.append((held, name, node.lineno))
+            if held is not None:
+                # candidate for one-level call expansion: self.m() / m()
+                if isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self":
+                    cls = self._class_stack[-1] if self._class_stack else ""
+                    info.calls_under.append((held, f"{cls}.{name}", node.lineno))
+                elif isinstance(node.func, ast.Name):
+                    info.calls_under.append((held, name, node.lineno))
+        self.generic_visit(node)
+
+    # -- env-default traps --------------------------------------------------
+
+    def _finding(self, check, severity, anchor, message, line):
+        self.findings.append(Finding(
+            check=check, severity=severity, target=self.relpath,
+            anchor=anchor, message=message, detail={"line": line},
+        ))
+
+    def visit_BoolOp(self, node):
+        if isinstance(node.op, ast.Or) and node.values:
+            self._check_env_or(node)
+        self.generic_visit(node)
+
+    def _check_env_or(self, node):
+        left = node.values[0]
+        env = _numeric_cast_of_env(left)
+        if env is not None:
+            var = _env_var_name(env)
+            self._finding(
+                "env-truthy-default", "P1", var,
+                f"`int({var}) or <default>`: an explicit `{var}=0` is falsy "
+                "AFTER the cast, so it silently becomes the default — if 0 "
+                "must be honored, parse with an explicit default argument; "
+                "if 0 really means 'use the default', baseline this with "
+                "that justification",
+                node.lineno,
+            )
+            return
+        env = _env_get_call(left)
+        if env is None:
+            return
+        var = _env_var_name(env)
+        has_default, default = _env_default(env)
+        if has_default and isinstance(default, str) and default:
+            # `env.get(K, "0") or X`: the non-empty string default is
+            # ALWAYS truthy, so X is unreachable for an unset var — the
+            # exact shape that pinned the quantize pool to one worker.
+            # Harmless only when X spells the same value as the default.
+            rhs = node.values[1:]
+            if all(isinstance(v, ast.Constant) and str(v.value) == default
+                   for v in rhs):
+                return
+            self._finding(
+                "env-dead-fallback", "P1", var,
+                f"`os.environ.get({var!r}, {default!r}) or <fallback>`: the "
+                f"non-empty string default {default!r} is always truthy, so "
+                "the fallback NEVER applies — an unset var silently parses "
+                f"as {default!r} instead; drop the string default (get(...) "
+                "or <fallback>) or drop the or",
+                node.lineno,
+            )
+            return
+        if id(node) not in self._cast_wrapped and any(
+            isinstance(v, ast.Constant)
+            and isinstance(v.value, (int, float))
+            and not isinstance(v.value, bool)
+            for v in node.values[1:]
+        ):
+            self._finding(
+                "env-default-type", "P2", var,
+                f"`os.environ.get({var!r}) or <number>` yields a STR when "
+                "the var is set and a number when unset — downstream "
+                "arithmetic/compares silently diverge; cast the env value",
+                node.lineno,
+            )
+
+    def _check_truth_test(self, test):
+        env = _env_get_call(test)
+        if env is None and isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            env = _env_get_call(test.operand)
+        if env is None:
+            return
+        has_default, default = _env_default(env)
+        if has_default and isinstance(default, str) and default:
+            var = _env_var_name(env)
+            self._finding(
+                "env-truthy-test", "P2", var,
+                f"truth-testing os.environ.get({var!r}, {default!r}): every "
+                "non-empty string — including \"0\" and \"false\" — is "
+                "truthy, so the branch is effectively constant; compare "
+                "against the accepted values instead",
+                test.lineno,
+            )
+
+    def visit_If(self, node):
+        self._check_truth_test(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_truth_test(node.test)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_truth_test(node.test)
+        self.generic_visit(node)
+
+
+def _expand_one_level(functions: dict):
+    """Fold each function's direct lock facts into its callers: a call
+    made while holding L inherits the callee's acquisitions (edge L ->
+    each) and the callee's callback invocations (they now run under L).
+    One level deep, by design — deeper chains exist but the signal/noise
+    of guessing dynamic dispatch drops fast."""
+    for info in functions.values():
+        for held, callee, line in info.calls_under:
+            target = functions.get(callee)
+            if target is None:
+                continue
+            for key, _ in target.acquires:
+                if key != held:
+                    info.edges.append((held, key, line))
+            for _, cb_name, _ in target.callback_calls:
+                info.callback_calls.append((held, f"{callee}:{cb_name}", line))
+
+
+def _lock_cycles(functions: dict) -> list:
+    """Cycles in the module's lock-order graph. Returns one record per
+    distinct cycle (as a sorted lock tuple): (locks, witnesses)."""
+    graph: dict = {}
+    witness: dict = {}
+    for info in functions.values():
+        for a, b, line in info.edges:
+            graph.setdefault(a, set()).add(b)
+            witness.setdefault((a, b), (info.qualname, line))
+    cycles = {}
+    # 2-cycles (the overwhelmingly common inversion) + longer via DFS
+    for a, succs in graph.items():
+        for b in succs:
+            if a in graph.get(b, ()):  # a->b and b->a
+                key = tuple(sorted((a, b)))
+                cycles.setdefault(key, [witness[(a, b)], witness[(b, a)]])
+    # longer cycles: DFS with a path stack
+    def dfs(node, path, on_path):
+        for nxt in graph.get(node, ()):
+            if nxt in on_path:
+                cyc = path[path.index(nxt):]
+                if len(cyc) > 2:
+                    key = tuple(sorted(cyc))
+                    if key not in cycles:
+                        cycles[key] = [
+                            witness[(cyc[i], cyc[(i + 1) % len(cyc)])]
+                            for i in range(len(cyc))
+                            if (cyc[i], cyc[(i + 1) % len(cyc)]) in witness
+                        ]
+            elif len(path) < 8:
+                dfs(nxt, path + [nxt], on_path | {nxt})
+    for start in graph:
+        dfs(start, [start], {start})
+    return sorted(cycles.items())
+
+
+def lint_source(src: str, relpath: str) -> list:
+    """Findings for one module's source (``relpath`` keys fingerprints —
+    pass repo-relative POSIX paths)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(
+            check="lint-parse-error", severity="P2", target=relpath,
+            message=f"host lint could not parse: {e}",
+        )]
+    lint = _ModuleLint(relpath)
+    lint.visit(tree)
+    _expand_one_level(lint.functions)
+    findings = list(lint.findings)
+    for locks, witnesses in _lock_cycles(lint.functions):
+        fns = ", ".join(f"{q}:{ln}" for q, ln in witnesses)
+        findings.append(Finding(
+            check="lock-inversion", severity="P1", target=relpath,
+            anchor="<->".join(locks),
+            message=f"lock-order inversion between {' and '.join(locks)}: "
+                    "two concurrent callers taking them in opposite order "
+                    f"deadlock (witnesses: {fns})",
+            detail={"lock_order": fns},
+        ))
+    seen_cb = set()
+    for info in lint.functions.values():
+        cls = info.qualname.rsplit(".", 1)[0] if "." in info.qualname else ""
+        for held, name, line in info.callback_calls:
+            if held is None:
+                continue
+            if ":" not in name and (
+                name in lint.functions or f"{cls}.{name}" in lint.functions
+            ):
+                # a function DEFINED here is not user-supplied code — the
+                # one-level expansion already surfaced whatever callbacks
+                # it actually invokes
+                continue
+            anchor = f"{info.qualname}|{held}|{name}"
+            if anchor in seen_cb:
+                continue
+            seen_cb.add(anchor)
+            findings.append(Finding(
+                check="callback-under-lock", severity="P1", target=relpath,
+                anchor=anchor,
+                message=f"{info.qualname} invokes user-supplied callable "
+                        f"`{name}` while holding {held}: a slow or "
+                        "re-entrant callback stalls or deadlocks every "
+                        "other holder — collect under the lock, invoke "
+                        "after release",
+                detail={"line": line},
+            ))
+    # fingerprint-level dedup (nested functions can re-walk a node)
+    out, seen = [], set()
+    for f in findings:
+        if f.fingerprint not in seen:
+            seen.add(f.fingerprint)
+            out.append(f)
+    return out
+
+
+def lint_file(path: str, relpath: Optional[str] = None) -> list:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    return lint_source(src, relpath or os.path.basename(path))
+
+
+def lint_paths(paths=None, root: Optional[str] = None) -> list:
+    """The host-lint pass: every ``.py`` under the given repo-relative
+    paths (files or directories), findings keyed by repo-relative path."""
+    from .hygiene import repo_root
+
+    root = root or repo_root()
+    findings = []
+    for rel in (paths or DEFAULT_LINT_PATHS):
+        full = os.path.join(root, rel)
+        if os.path.isfile(full):
+            files = [full]
+        else:
+            files = sorted(
+                os.path.join(dp, f)
+                for dp, _, fs in os.walk(full) for f in fs if f.endswith(".py")
+            )
+        for path in files:
+            relpath = os.path.relpath(path, root).replace(os.sep, "/")
+            findings.extend(lint_file(path, relpath))
+    return findings

@@ -1,7 +1,12 @@
 """`accelerate-tpu` CLI entry (parity: reference commands/accelerate_cli.py).
 
-Subcommands are registered lazily; each lives in its own module. This is a
-stub while the CLI layer is built out — `env` works today.
+Subcommands are registered lazily; each lives in its own module. When the
+requested command is recognizable from argv, ONLY that module is imported
+— `launch` statically reaches jax (utils/__init__ -> utils.memory), and
+the log-reading commands (`trace`, `report`, `watch`, `audit --host-only`)
+must run on machines with no accelerator stack and must not bill a jax
+import to their startup. A bare `accelerate-tpu` / `--help` imports
+everything to render the full command list.
 """
 
 from __future__ import annotations
@@ -9,8 +14,14 @@ from __future__ import annotations
 import argparse
 import sys
 
+_COMMANDS = (
+    "config", "launch", "estimate", "merge", "test", "tpu_config",
+    "trace", "report", "watch", "audit",
+)
+
 
 def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
     parser = argparse.ArgumentParser(
         "accelerate-tpu", usage="accelerate-tpu <command> [<args>]"
     )
@@ -19,12 +30,12 @@ def main(argv=None):
     from . import env
 
     env.register(subparsers)
-    registered = {"env"}
-    for name in ("config", "launch", "estimate", "merge", "test", "tpu_config", "trace", "report", "watch"):
+    requested = next((a for a in argv if not a.startswith("-")), None)
+    names = (requested,) if requested in _COMMANDS else _COMMANDS
+    for name in names:
         try:
             module = __import__(f"accelerate_tpu.commands.{name}", fromlist=["register"])
             module.register(subparsers)
-            registered.add(name)
         except ImportError:
             continue
 

@@ -1,0 +1,206 @@
+"""`accelerate-tpu audit` — static invariant checks, before anything runs.
+
+Two passes behind one findings model (``accelerate_tpu.analysis``):
+
+- **host lint** (always; fully jax-free): AST checks over the telemetry/
+  serving host modules — lock-order inversions, user callbacks invoked
+  under a lock, env-var default traps — plus the import-hygiene
+  reachability check against the declared jax-free module set
+  (``analysis/hygiene.py``, the same source of truth
+  ``tests/test_imports.py`` derives its probes from).
+- **program audit** (when jax is importable; ``--host-only`` skips):
+  traces the repo's own registered entry points — the paged serving
+  engine's full warmup program set and the fused train step — and flags
+  baked constants, donation misses, f32 drift, host callbacks and
+  weak-shape dependencies. Tracing only: nothing executes, nothing
+  compiles (``--compile-check`` opts into the memory_analysis aliasing
+  cross-check, which does compile).
+
+Findings carry stable fingerprints; ``audit-baseline.json`` suppresses
+the deliberate ones, each with a justification this CLI renders. Exit
+status is non-zero exactly when an **unbaselined P1** finding exists, so
+the tier-1 test gate doubles as the CI gate.
+
+    accelerate-tpu audit                         # both passes, repo baseline
+    accelerate-tpu audit --host-only             # log-only machines: no jax
+    accelerate-tpu audit --json                  # machine-readable
+    accelerate-tpu audit --out runs/x/telemetry  # audit.json for `report`
+    accelerate-tpu audit --update-baseline --justify "why"   # suppress actives
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _host_findings(paths, root):
+    from ..analysis.host_lint import lint_paths
+    from ..analysis.hygiene import hygiene_findings
+
+    findings = lint_paths(paths or None, root=root)
+    findings.extend(hygiene_findings(root))
+    return findings
+
+
+def _program_findings(args):
+    from ..analysis import program_audit
+
+    kw = {}
+    if args.const_mb is not None:
+        kw["const_bytes"] = int(args.const_mb * (1 << 20))
+    if args.donation_kb is not None:
+        kw["donation_bytes"] = int(args.donation_kb * (1 << 10))
+    return program_audit.self_audit(
+        include_train=not args.no_train, warmup=args.warmup,
+        compile_check=args.compile_check, **kw,
+    )
+
+
+def run_audit(args) -> int:
+    from ..analysis.findings import (
+        Baseline,
+        render_findings,
+        sort_findings,
+        summarize,
+    )
+
+    root = args.root or _default_root()
+    baseline_path = args.baseline or os.path.join(root, "audit-baseline.json")
+    baseline = Baseline.load(baseline_path)
+
+    findings = []
+    notes = []
+    t0 = time.perf_counter()
+    if not args.programs_only:
+        findings.extend(_host_findings(args.paths, root))
+        notes.append(f"host lint: {time.perf_counter() - t0:.2f}s")
+    if not args.host_only:
+        try:
+            import jax  # noqa: F401  (the program pass needs a backend)
+
+            has_jax = True
+        except Exception:
+            has_jax = False
+        if has_jax:
+            t1 = time.perf_counter()
+            findings.extend(_program_findings(args))
+            notes.append(f"program audit: {time.perf_counter() - t1:.2f}s")
+        else:
+            notes.append(
+                "program audit skipped: jax not importable here (host lint "
+                "is authoritative on log-only machines; run the program "
+                "pass where the accelerator stack lives)"
+            )
+
+    active, suppressed = baseline.split(findings)
+    active, suppressed = sort_findings(active), sort_findings(suppressed)
+    stale = baseline.stale_entries(findings)
+
+    if args.update_baseline:
+        if not args.justify:
+            print("audit --update-baseline requires --justify \"<reason>\"",
+                  file=sys.stderr)
+            return 2
+        for f in active:
+            baseline.add(f, args.justify)
+        baseline.save(baseline_path)
+        print(f"baselined {len(active)} finding(s) into {baseline_path}",
+              file=sys.stderr)
+        suppressed = suppressed + active
+        active = []
+
+    payload = {
+        "findings": [f.to_dict() for f in active],
+        "suppressed": [f.to_dict() for f in suppressed],
+        "summary": summarize(active),
+        "stale_baseline": stale,
+        "baseline": baseline_path if baseline.entries else None,
+        "notes": notes,
+        "time_unix_s": round(time.time(), 3),
+    }
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        out_path = os.path.join(args.out, "audit.json")
+        tmp = f"{out_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        os.replace(tmp, out_path)
+
+    p1 = payload["summary"]["findings_p1"]
+    if args.json:
+        print(json.dumps(payload))
+        return 1 if p1 else 0
+
+    print(f"== accelerate-tpu audit: {root} ==")
+    for note in notes:
+        print(f"  ({note})")
+    for line in render_findings(active, suppressed):
+        print(line)
+    if stale:
+        print(f"  {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (violation fixed — "
+              "delete from the baseline):")
+        for fp, entry in sorted(stale.items()):
+            print(f"    {fp}  {entry.get('check')}  {entry.get('target')}")
+    if p1:
+        print(f"audit: {p1} unbaselined P1 finding(s) — failing", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _default_root() -> str:
+    # the analysis package knows where the repo root is relative to the
+    # installed package; a checked-out tree and an installed wheel agree
+    from ..analysis.hygiene import repo_root
+
+    return repo_root()
+
+
+def register(subparsers):
+    parser = subparsers.add_parser(
+        "audit",
+        help="Static invariant audit: lint host code (locks/callbacks/env "
+             "defaults, jax-free) and trace registered jitted programs "
+             "(baked constants, donation misses, f32 drift); exits non-zero "
+             "on unbaselined P1 findings",
+    )
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected)")
+    parser.add_argument("--paths", nargs="*", default=None,
+                        help="host-lint paths relative to the root "
+                             "(default: telemetry/serving/commands/utils/runtime)")
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument("--host-only", action="store_true",
+                      help="host lint + hygiene only (no jax import — safe "
+                           "on log-only machines)")
+    mode.add_argument("--programs-only", action="store_true",
+                      help="program audit only")
+    parser.add_argument("--baseline", default=None,
+                        help="suppression file (default: <root>/audit-baseline.json)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="add every active finding to the baseline "
+                             "(requires --justify)")
+    parser.add_argument("--justify", default=None,
+                        help="justification recorded with --update-baseline")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument("--out", default=None, metavar="DIR",
+                        help="also write audit.json into DIR (what "
+                             "`accelerate-tpu report` renders and --diff "
+                             "counts as a regression signal)")
+    parser.add_argument("--warmup", action="store_true",
+                        help="warm the self-audit engine first (compiles; "
+                             "audits the post-warmup program set exactly)")
+    parser.add_argument("--no-train", action="store_true",
+                        help="skip the train-step spec in the program pass")
+    parser.add_argument("--compile-check", action="store_true",
+                        help="allow .compile() for the memory_analysis "
+                             "aliasing cross-check on donation findings")
+    parser.add_argument("--const-mb", type=float, default=None,
+                        help="baked-constant threshold in MiB (default 1.0)")
+    parser.add_argument("--donation-kb", type=float, default=None,
+                        help="donation-miss threshold in KiB (default 64)")
+    parser.set_defaults(func=run_audit)
+    return parser

@@ -12,6 +12,7 @@ time go and why". It merges everything a session leaves behind —
     timeline-host<i>.jsonl   continuous gauge timeline (sampled rollups)
     alerts-host<i>.jsonl     alert lifecycle events (pending/firing/resolved)
     usage-host<i>.json       per-tenant usage accounting
+    audit.json               static-audit findings (`accelerate-tpu audit --out`)
 
 — into one explanation:
 
@@ -269,6 +270,17 @@ def load_fleet_summary(target: str) -> dict:
     return load_fleet(target)
 
 
+def load_audit(target: str) -> dict:
+    """The static-audit snapshot (``audit.json`` written by
+    ``accelerate-tpu audit --out DIR``): active findings, baselined
+    suppressions, and the severity summary."""
+    for path in _host_files(target, "audit.json"):
+        data = _load_json(path)
+        if isinstance(data, dict):
+            return data
+    return {}
+
+
 def load_report(target: str) -> dict:
     forensics = load_forensics(target)
     data = {
@@ -283,6 +295,7 @@ def load_report(target: str) -> dict:
         "alerts": load_alert_summary(target),
         "usage": load_usage_table(target),
         "fleet": load_fleet_summary(target),
+        "audit": load_audit(target),
     }
     req_files = _host_files(target, "requests-host*.jsonl")
     if req_files:
@@ -487,6 +500,38 @@ def format_report(data: dict) -> str:
                 else str(row.get(c, 0)) for c in cols
             ))
         lines.extend(render_table(table))
+
+    audit = data.get("audit") or {}
+    if audit:
+        summ = audit.get("summary") or {}
+        # severity-major before truncating: a P1 must never hide behind
+        # twelve P2s in discovery order
+        sev_rank = {"P1": 0, "P2": 1, "P3": 2}
+        active = sorted(
+            audit.get("findings") or [],
+            key=lambda f: (sev_rank.get(f.get("severity"), 9),
+                           str(f.get("target")), str(f.get("check"))),
+        )
+        suppressed = audit.get("suppressed") or []
+        lines.append("")
+        lines.append(
+            f"static audit: {summ.get('findings_total', len(active))} active "
+            f"finding(s) ({summ.get('findings_p1', 0)} P1), "
+            f"{len(suppressed)} baselined"
+        )
+        for f in active[:12]:
+            lines.append(
+                f"  [{f.get('severity', '?')}] {f.get('check')}  "
+                f"{f.get('target')}  ({f.get('fingerprint', '?')})"
+            )
+            lines.append(f"       {f.get('message', '')}")
+        if len(active) > 12:
+            lines.append(f"  (+{len(active) - 12} more in --json)")
+        for f in suppressed[:6]:
+            lines.append(
+                f"  [baselined {f.get('severity', '?')}] {f.get('check')}  "
+                f"{f.get('target')}: {f.get('justification', '?')}"
+            )
     return "\n".join(lines)
 
 
@@ -550,6 +595,18 @@ def collect_diff_metrics(target: str) -> dict:
     for tenant, row in ((data.get("usage") or {}).get("tenants") or {}).items():
         _flatten_numeric(row, f"usage/{tenant}", out)
     out["recompiles_diagnosed"] = float(len(data.get("recompiles") or []))
+    audit = data.get("audit") or {}
+    if audit:
+        # audit findings are a regression signal: the counts diff like any
+        # metric, and each active P1 additionally travels as its own
+        # fingerprint key so a NEW P1 between two runs is flagged even
+        # when the count happens to stay level (one fixed, one introduced)
+        summ = audit.get("summary") or {}
+        out["audit/findings_total"] = float(summ.get("findings_total", 0))
+        out["audit/findings_p1"] = float(summ.get("findings_p1", 0))
+        for f in audit.get("findings") or []:
+            if f.get("severity") == "P1" and f.get("fingerprint"):
+                out[f"audit/p1/{f['fingerprint']}"] = 1.0
     return out
 
 
@@ -573,6 +630,13 @@ def diff_metrics(a: dict, b: dict, threshold: float = 0.1,
         rows.append({"metric": key, "a": va, "b": vb,
                      "rel_change": round(rel, 4) if rel is not None else None,
                      "from_zero": rel is None})
+    # a P1 audit finding that exists only in B is NEW regression evidence
+    # even though unshared keys normally stay out of the flag list (the
+    # count metrics can stay level when one P1 is fixed and another lands)
+    for key in sorted(set(b) - set(a)):
+        if key.startswith("audit/p1/"):
+            rows.append({"metric": key, "a": 0.0, "b": b[key],
+                         "rel_change": None, "from_zero": True})
     flagged = [r for r in rows
                if r["from_zero"] or abs(r["rel_change"]) > threshold]
     flagged.sort(key=lambda r: -(float("inf") if r["from_zero"]
@@ -635,10 +699,10 @@ def report_command(args) -> int:
     if not (data["goodput"] or data["costs"].get("executables")
             or data["recompiles"] or data["first_compiles"] or data["steps"]
             or data["timeline"] or data["usage"] or data["alerts"]
-            or data["fleet"]):
+            or data["fleet"] or data["audit"]):
         print(f"no telemetry artifacts found under {args.target} — expected "
               "goodput-host*.json / costs-host*.json / forensics-host*.jsonl "
-              "/ fleet.json (see docs/telemetry.md)", file=sys.stderr)
+              "/ fleet.json / audit.json (see docs/telemetry.md)", file=sys.stderr)
         return 1
     if args.json:
         print(json.dumps(data))

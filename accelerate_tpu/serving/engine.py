@@ -745,6 +745,85 @@ class ServingEngine:
         self.executable_memory_stats()
         return self
 
+    def audit_entrypoints(self) -> list:
+        """Entry-point specs for the static program auditor
+        (``accelerate_tpu.analysis.program_audit``): every program
+        ``warmup()`` compiles — prefill buckets, the decode step and the
+        ``steps_per_call`` burst, spec verify, the page-table maintenance
+        programs — with the example args warmup itself would pass and the
+        *effective* donation sets. Trace-only consumers: building the
+        specs executes nothing and compiles nothing, so this is safe on
+        a live engine (the jitted-fn caches it touches are the ones
+        warmup populates anyway). ``donate_expected`` mirrors
+        ``self._donate`` so the CPU sim's deliberate no-donation policy
+        is not reported as a donation miss."""
+        rng = jax.random.PRNGKey(0)
+        paged = self.page_size is not None
+        dtype = np.dtype(self.definition.config.dtype).name
+        pk = {"page_tables": self._page_tables} if paged else {}
+        donate_on = self._donate
+        specs = []
+        for bucket in self.prefill_chunks:
+            warm_chunk = jnp.zeros((1, bucket), jnp.int32)
+            specs.append(dict(
+                name=f"prefill_{bucket}", fn=self._prefill_fn(bucket),
+                args=(self.params, self._arena, warm_chunk, 0, 0, bucket - 1, rng),
+                kwargs=dict(pk), donate=(1,) if donate_on else (),
+                donate_expected=donate_on, compute_dtype=dtype,
+            ))
+        step_extra = (self._page_tables,) if paged else ()
+        step_args = (self.params, self._arena, self._tokens, self._lengths,
+                     self._active, self._rngs) + step_extra
+        step_donate = (1, 2, 3, 5) if donate_on else ()
+        # NB: no shape_probe on the engine's own programs, deliberately.
+        # The weak-shape check compares shape-derived scalar literals
+        # between two traces, and the batched per-slot RNG chains bake
+        # num_slots into threefry's counter math inside jax itself — a
+        # library-inherent encoding every batched-RNG program has, not a
+        # user bug. The engine's zero-recompile invariant holds by fixed
+        # shapes (warmup + the compile-counter tests witness it); the
+        # probe-based check is for shape-polymorphic USER programs.
+        specs.append(dict(
+            name="decode_step", fn=self._decode_step, args=step_args,
+            donate=step_donate, donate_expected=donate_on, compute_dtype=dtype,
+        ))
+        if self.steps_per_call > 1:
+            specs.append(dict(
+                name=f"decode_burst{self.steps_per_call}",
+                fn=self._decode_burst(self.steps_per_call), args=step_args,
+                donate=step_donate, donate_expected=donate_on,
+                compute_dtype=dtype,
+            ))
+        if self._verify_step is not None:
+            warm_drafts = jnp.zeros((self.num_slots, self.spec_k), jnp.int32)
+            specs.append(dict(
+                name="spec_verify", fn=self._verify_step,
+                args=(self.params, self._arena, self._tokens, warm_drafts,
+                      self._lengths, self._active, self._rngs,
+                      self._page_tables),
+                donate=(1, 2, 4, 6) if donate_on else (),
+                donate_expected=donate_on, compute_dtype=dtype,
+            ))
+        if paged:
+            table_donate = (0,) if donate_on else ()
+            specs.append(dict(
+                name="table_set_row", fn=self._set_row,
+                args=(self._page_tables, 0,
+                      jnp.asarray(self._tables_host.rows[0])),
+                donate=table_donate, donate_expected=donate_on,
+            ))
+            specs.append(dict(
+                name="table_set_entry", fn=self._set_entry,
+                args=(self._page_tables, 0, 0, 0),
+                donate=table_donate, donate_expected=donate_on,
+            ))
+            specs.append(dict(
+                name="page_fork", fn=self._fork, args=(self._arena, 0, 0),
+                donate=(0,) if donate_on else (), donate_expected=donate_on,
+                compute_dtype=dtype,
+            ))
+        return specs
+
     # -- request API -------------------------------------------------------
 
     def submit(

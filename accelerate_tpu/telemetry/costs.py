@@ -202,6 +202,13 @@ class CostRegistry:
 
     # -- consumers ---------------------------------------------------------
 
+    def executable_names(self) -> list:
+        """Every executable the registry has a row for — the second half
+        of the registry-exposure contract the static auditor
+        (``accelerate_tpu.analysis``) audits its coverage against."""
+        with self._lock:
+            return sorted(self.entries)
+
     def rows(self, probe: bool = True) -> list:
         """Per-executable roofline rows (wall-descending), with the derived
         utilization numbers where both cost and wall are known.

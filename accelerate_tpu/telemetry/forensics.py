@@ -195,6 +195,18 @@ class ForensicsRecorder:
             info["statics"] = {k: repr(v)[:80] for k, v in dict(statics).items()}
         self._static_info[fn] = info
 
+    def registered_entrypoints(self) -> dict:
+        """name -> registration metadata for every entry point that has
+        registered OR fingerprinted a call — the enumeration surface the
+        static auditor (``accelerate_tpu.analysis``) cross-checks its
+        coverage against, so a new jitted program wired into an engine
+        cannot silently skip the audit."""
+        with self._lock:
+            out = {fn: dict(info) for fn, info in self._static_info.items()}
+            for fn in self._seen:
+                out.setdefault(fn, {})
+            return out
+
     def note_call(self, fn: str, tree) -> Optional[dict]:
         """Fingerprint one call of ``fn``. Returns the newly-opened event
         record when the signature is new (the fast path returns None)."""
@@ -312,3 +324,10 @@ def register(fn: str, **meta):
     rec = _ACTIVE
     if rec is not None:
         rec.register(fn, **meta)
+
+
+def registered_entrypoints() -> dict:
+    """The armed recorder's entry-point enumeration (empty when forensics
+    is off) — what ``accelerate-tpu audit`` uses for coverage."""
+    rec = _ACTIVE
+    return rec.registered_entrypoints() if rec is not None else {}

@@ -493,9 +493,15 @@ def _stream_device_leaves(device_paths, flat_loaded, shardings, dtype,
     from .quantization import _eligible, quantize_array_host
 
     serial = os.environ.get("ATT_SERIAL_DISPATCH", "0").lower() not in ("0", "false", "")
-    readahead = int(
-        float(os.environ.get("ATT_DISPATCH_READAHEAD_MB", "0") or 0) * (1 << 20)
-    ) or _READAHEAD_BYTES_DEFAULT
+    # explicit-0 is honored (the gate never blocks an empty pipeline, so
+    # limit 0 means fully-serial readahead); only unset/empty falls back —
+    # `int(...) or default` would silently turn an explicit 0 into 256 MB
+    # (the truthy-env-default class the audit host linter flags)
+    readahead_mb = os.environ.get("ATT_DISPATCH_READAHEAD_MB")
+    readahead = (
+        int(float(readahead_mb) * (1 << 20)) if readahead_mb not in (None, "")
+        else _READAHEAD_BYTES_DEFAULT
+    )
 
     out: dict[str, Any] = {}
     pending: list = []  # ("plain", path, np_value, sharding|None)

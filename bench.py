@@ -1476,6 +1476,34 @@ def _pipeline_mem_bench() -> dict:
         return {}
 
 
+def _audit_rows():
+    """Post-warmup static-audit pass (`accelerate-tpu audit` in-process):
+    host lint + import hygiene + the program auditor over a warmed tiny
+    serving engine and the fused train step, counted modulo the repo's
+    checked-in ``audit-baseline.json``. Published as bench rows so
+    `report --diff` treats a new P1 finding exactly like a perf
+    regression (the per-fingerprint keys ride the telemetry-dir path)."""
+    try:
+        from accelerate_tpu.analysis import host_lint, hygiene, program_audit
+        from accelerate_tpu.analysis.findings import Baseline, summarize
+
+        findings = host_lint.lint_paths()
+        findings += hygiene.hygiene_findings()
+        findings += program_audit.self_audit(warmup=True)
+        baseline = Baseline.load(
+            os.path.join(hygiene.repo_root(), "audit-baseline.json")
+        )
+        active, suppressed = baseline.split(findings)
+        s = summarize(active)
+        return {
+            "audit_findings_p1": s["findings_p1"],
+            "audit_findings_total": s["findings_total"],
+            "audit_findings_baselined": len(suppressed),
+        }
+    except Exception as e:  # the audit must never sink the bench
+        return {"audit_error": repr(e)[:200]}
+
+
 def main():
     import argparse
 
@@ -1820,6 +1848,9 @@ def main():
         extra["serving_isolation_degradation_x"] = (
             extra["serving_isolation"]["storm_degradation_x"]
         )
+
+    # static-audit regression rows (both branches; post-warmup pass)
+    extra.update(_audit_rows())
 
     print(
         f"[bench] backend={jax.default_backend()} tokens/s={tok_s:,.0f} "

@@ -1,0 +1,67 @@
+"""Deliberately-bad host snippets — the golden corpus for the audit
+host linter (tests/test_audit.py). Every construct here reproduces a
+bug class a past PR paid for at runtime; the tests assert the linter
+flags each with an exact fingerprint + severity and nothing else.
+
+NEVER import this module from production code (it is test data; the
+env reads and the lock patterns are the *disease*, not an idiom).
+"""
+
+import os
+import threading
+
+
+class BadLockOrder:
+    """Seeds one lock-order inversion (evaluate vs dump) and two
+    callback-under-lock sites (direct + one call level down)."""
+
+    def __init__(self):
+        self._alert_lock = threading.Lock()
+        self._dump_lock = threading.Lock()
+        self.on_fire = None
+        self.action_fn = None
+
+    def evaluate(self):
+        with self._alert_lock:
+            with self._dump_lock:  # A then B
+                return 1
+
+    def dump(self):
+        with self._dump_lock:
+            with self._alert_lock:  # B then A — inversion
+                return 2
+
+    def fire(self):
+        with self._alert_lock:
+            self.on_fire()  # user callback invoked under the lock
+
+    def fire_indirect(self):
+        with self._alert_lock:
+            self._run_actions()  # callee invokes a callback lock-free...
+
+    def _run_actions(self):
+        self.action_fn()  # ...but runs under the caller's lock
+
+
+def quantize_pool_workers():
+    # the truthy-"0"-default class: "0" is a non-empty STRING, so the
+    # `or` fallback is dead and an unset var parses as 0 workers
+    return int(os.environ.get("BAD_POOL_THREADS", "0") or 4)
+
+
+def readahead_bytes():
+    # int-before-fallback trap: an explicit BAD_READAHEAD_MB=0 is falsy
+    # AFTER the cast and silently becomes 256
+    return int(os.environ.get("BAD_READAHEAD_MB") or 0) or 256
+
+
+def request_timeout():
+    # str-when-set, int-when-unset
+    return os.environ.get("BAD_TIMEOUT_S") or 30
+
+
+def feature_enabled():
+    # "0" and "false" are truthy strings — this branch is constant-true
+    if os.environ.get("BAD_FLAG", "0"):
+        return True
+    return False
