@@ -51,10 +51,13 @@ JAX_FREE_MODULES = (
     "accelerate_tpu.serving.pages",
     "accelerate_tpu.serving.scheduler",
     "accelerate_tpu.serving.faults",
+    "accelerate_tpu.serving.router",
+    "accelerate_tpu.serving.replica_server",
     "accelerate_tpu.commands.trace",
     "accelerate_tpu.commands.report",
     "accelerate_tpu.commands.watch",
     "accelerate_tpu.commands.audit",
+    "accelerate_tpu.commands.serve",
     "accelerate_tpu.analysis",
     "accelerate_tpu.analysis.findings",
     "accelerate_tpu.analysis.hygiene",

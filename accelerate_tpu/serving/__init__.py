@@ -28,6 +28,14 @@ _EXPORTS = {
     "SchedulerConfig": "scheduler",
     "TenantConfig": "scheduler",
     "FaultInjector": "faults",
+    "StreamDropped": "faults",
+    # the multi-replica data plane: the router tier (jax-free) and the
+    # per-replica HTTP wrapper (jax-free at import; wraps a live engine)
+    "Router": "router",
+    "RouterConfig": "router",
+    "RouterServer": "router",
+    "backoff_schedule": "router",
+    "ReplicaServer": "replica_server",
 }
 
 __all__ = list(_EXPORTS)

@@ -52,7 +52,9 @@ from .pages import (
     PrefixCache,
     dense_slot_view,
     fork_page,
+    gather_pages,
     init_paged_arena,
+    install_page,
     kv_cache_bits,
     scatter_slot_view,
     set_table_entry,
@@ -344,6 +346,10 @@ class ServingEngine:
             self._fork = jax.jit(
                 fork_page, donate_argnums=(0,) if self._donate else ()
             )
+            # KV-handoff import write (one page per dispatch, traced dst)
+            self._install_page = jax.jit(
+                install_page, donate_argnums=(0,) if self._donate else ()
+            )
             self._verify_step = (
                 jax.jit(self._build_verify_core(),
                         donate_argnums=(1, 2, 4, 6) if self._donate else ())
@@ -358,6 +364,8 @@ class ServingEngine:
             self._kernel_costed_verify = False
             self._arena = init_arena(definition, params, self.num_slots, self._placer)
         self.page_forks = 0
+        self.kv_pages_exported = 0
+        self.kv_pages_imported = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.prefill_chunks_skipped = 0
@@ -683,6 +691,12 @@ class ServingEngine:
             )
             self._page_tables = self._set_entry(self._page_tables, 0, 0, 0)
             self._arena = self._fork(self._arena, 0, 0)
+            # the KV-handoff install program: write a zeros page into the
+            # parking page (whose content is unreachable by construction),
+            # so a post-steady import of handed-off pages never compiles
+            self._arena = self._install_page(
+                self._arena, self._page_slice_tree(), 0
+            )
             if self._kernel_costed and costs is not None:
                 # seed the kernel's dynamic roofline row at warmup so a
                 # rollup/report taken before traffic already lists the
@@ -1528,6 +1542,177 @@ class ServingEngine:
             self._page_tables, slot, jnp.asarray(th.rows[slot])
         )
 
+    # -- KV handoff (prefill -> decode replicas, session migration) ---------
+
+    def _page_slice_tree(self, arrays=None, page_index: int = 0):
+        """Pytree matching the arena where every K/V leaf is a size-1
+        page slice — what the compiled install program consumes. With
+        ``arrays`` (the per-leaf host arrays a handoff carries, arena
+        flatten order), the slice is that payload's ``page_index``-th
+        page; without, zeros (the warmup compile). Non-K/V leaves become
+        fresh zeros so nothing aliases the donated arena."""
+        from .pages import _is_kv, _page_axis
+
+        flat, treedef = jax.tree_util.tree_flatten(self._arena)
+        it = iter(arrays) if arrays is not None else None
+        leaves = []
+        for leaf in flat:
+            if _is_kv(leaf):
+                axis = _page_axis(leaf)
+                if it is None:
+                    shape = list(leaf.shape)
+                    shape[axis] = 1
+                    leaves.append(jnp.zeros(shape, leaf.dtype))
+                else:
+                    leaves.append(
+                        jnp.asarray(np.take(next(it), [page_index], axis=axis))
+                    )
+            else:
+                leaves.append(jnp.zeros(leaf.shape, leaf.dtype))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _kv_leaf_specs(self) -> list:
+        """(path, leaf) for every K/V leaf, arena flatten order — the
+        handoff wire format's leaf identity (payloads AND scale arenas:
+        same rank by design, so they always travel together)."""
+        from .pages import _is_kv
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(self._arena)
+        return [
+            (jax.tree_util.keystr(path), leaf)
+            for path, leaf in flat if _is_kv(leaf)
+        ]
+
+    def export_prefix_kv(self, tokens) -> Optional[dict]:
+        """Export the longest cached prefix of ``tokens`` as a KV handoff:
+        the quantized payload+scales pages shipped VERBATIM (bytes off the
+        arena, no dequant/requant round trip — the PR 10 wire format), so
+        an importing replica admits the prefix bit-identically to a local
+        warm-cache hit. Returns None when nothing is cached. A prefill
+        replica calls this for a finished prompt; a router calls it to
+        migrate a session's KV off a draining replica. The probe uses
+        ``PrefixCache.peek`` — exports never skew the hit gauges."""
+        if not self.page_size or self._prefix is None:
+            raise ValueError(
+                "KV handoff needs the paged arena with the prefix cache "
+                "(page_size=..., prefix_cache=True)"
+            )
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size < 1:
+            return None
+        hit_len, entry = self._prefix.peek(tokens)
+        if not hit_len:
+            return None
+        import base64
+
+        n_pages = -(-hit_len // self.page_size)
+        ids = [int(p) for p in entry.pages[:n_pages]]
+        leaves = []
+        for (path, leaf), pages in zip(
+            self._kv_leaf_specs(), gather_pages(self._arena, ids)
+        ):
+            leaves.append({
+                "path": path,
+                "dtype": pages.dtype.name,
+                "shape": list(pages.shape),
+                "data": base64.b64encode(
+                    np.ascontiguousarray(pages).tobytes()
+                ).decode("ascii"),
+            })
+        self.kv_pages_exported += n_pages
+        return {
+            "version": 1,
+            "page_size": self.page_size,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "token_len": int(hit_len),
+            "tokens": [int(t) for t in tokens[:hit_len]],
+            "n_pages": n_pages,
+            "replica": self.replica,
+            "leaves": leaves,
+        }
+
+    def import_prefix_kv(self, handoff: dict) -> int:
+        """Install a peer's KV handoff into this arena's prefix cache:
+        allocate pages, write each payload page through the (warmup-
+        compiled) install program, register the token prefix — so the
+        next admission of those tokens takes the prefix-hit path exactly
+        as if this replica had prefilled them itself. Returns the token
+        length now served from cache (0 when page pressure blocked the
+        install — a handoff is an optimization, never worth shedding live
+        work for). Raises ValueError on an incompatible wire format
+        (page size, KV dtype, or leaf layout mismatch)."""
+        if not self.page_size or self._prefix is None:
+            raise ValueError(
+                "KV handoff needs the paged arena with the prefix cache "
+                "(page_size=..., prefix_cache=True)"
+            )
+        if handoff.get("version") != 1:
+            raise ValueError(f"unknown KV handoff version {handoff.get('version')!r}")
+        if int(handoff["page_size"]) != self.page_size:
+            raise ValueError(
+                f"KV handoff page_size {handoff['page_size']} != engine "
+                f"page_size {self.page_size}"
+            )
+        if (handoff.get("kv_cache_dtype") or "bf16") != self.kv_cache_dtype:
+            raise ValueError(
+                f"KV handoff kv_cache_dtype {handoff.get('kv_cache_dtype')!r} "
+                f"!= engine {self.kv_cache_dtype!r}"
+            )
+        tokens = np.asarray(handoff["tokens"], np.int32).reshape(-1)
+        token_len = int(handoff["token_len"])
+        n_pages = int(handoff["n_pages"])
+        if tokens.size != token_len or n_pages != -(-token_len // self.page_size):
+            raise ValueError("KV handoff token/page accounting is inconsistent")
+        have, _ = self._prefix.peek(tokens)
+        if have >= token_len:
+            return have  # already cached at least this deep: nothing to do
+        import base64
+
+        from .pages import _page_axis
+
+        specs = self._kv_leaf_specs()
+        wire = handoff["leaves"]
+        if len(wire) != len(specs):
+            raise ValueError(
+                f"KV handoff carries {len(wire)} K/V leaves, engine arena "
+                f"has {len(specs)} — different model/cache layout"
+            )
+        arrays = []
+        for (path, leaf), spec in zip(specs, wire):
+            axis = _page_axis(leaf)
+            expect = list(leaf.shape)
+            expect[axis] = n_pages
+            arr = np.frombuffer(
+                base64.b64decode(spec["data"]), np.dtype(spec["dtype"])
+            ).reshape(spec["shape"])
+            if spec["path"] != path or list(arr.shape) != expect \
+                    or arr.dtype != leaf.dtype:
+                raise ValueError(
+                    f"KV handoff leaf {spec['path']} "
+                    f"({spec['dtype']}{spec['shape']}) does not match engine "
+                    f"leaf {path} ({leaf.dtype.name}, page-gathered {expect})"
+                )
+            arrays.append(arr)
+        pages = []
+        try:
+            for _ in range(n_pages):
+                pages.append(self._alloc_page())
+        except PagePressure:
+            for p in pages:
+                self._allocator.release(p)
+            return 0
+        for i, dst in enumerate(pages):
+            self._arena = self._install_page(
+                self._arena, self._page_slice_tree(arrays, i), dst
+            )
+        self._prefix.insert(tokens, pages)
+        # the cache entries hold the refs now; drop the allocation refs so
+        # LRU eviction can reclaim the pages under real pressure
+        for p in pages:
+            self._allocator.release(p)
+        self.kv_pages_imported += n_pages
+        return token_len
+
     def _pop_next(self) -> Optional[Request]:
         """Next request to admit: the scheduler's WFQ/priority pick, or
         the FIFO head. Lazily skips requests that went terminal while
@@ -2051,6 +2236,9 @@ class ServingEngine:
             out["serving/page_size"] = self.page_size
             out["serving/page_forks"] = self.page_forks
             out["serving/decode_kernel_active"] = bool(self._kernel_costed)
+            if self.kv_pages_exported or self.kv_pages_imported:
+                out["serving/kv_pages_exported"] = self.kv_pages_exported
+                out["serving/kv_pages_imported"] = self.kv_pages_imported
             if self._prefix is not None:
                 out["serving/prefix_hit_ratio"] = self._prefix.hit_ratio
                 out["serving/prefix_hit_tokens"] = self._prefix.hit_tokens

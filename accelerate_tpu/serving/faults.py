@@ -17,7 +17,12 @@ makes "things go wrong" reproducible:
   radius to that one request (outcome ``cancelled``), never the loop;
 - **tenant storms** — a callable fired at a chosen engine step,
   typically a burst of ``submit()`` calls mid-flight (the mixed-tenant
-  isolation tests ride this).
+  isolation tests ride this);
+- **network faults** — connection-refused, slow-replica latency, and
+  mid-stream drops injected at the *router's* transport layer
+  (``serving/router.py`` consults ``before_connect`` /
+  ``on_stream_event``): the same injector that drove the single-engine
+  scheduler drills drives the multi-replica failover and kill drills.
 
 Everything is **seeded and scripted**: probabilistic faults draw from a
 private ``random.Random(seed)``, scheduled faults key on the engine's
@@ -37,6 +42,12 @@ from typing import Callable, Optional
 
 class PoisonError(RuntimeError):
     """What a poisoned request's ``on_token`` callback raises."""
+
+
+class StreamDropped(ConnectionError):
+    """A replica's token stream ended mid-flight without a terminal
+    event — what the router sees when a replica dies while streaming
+    (and what the ``drop_stream`` fault injects)."""
 
 
 def poison_on_token(token, req):
@@ -64,6 +75,8 @@ class FaultInjector:
         self._delays: list = []     # dicts: phase/every/prob/delay_s/start/stop
         self._squeezes: list = []   # dicts: at_step/pages/hold_steps/held
         self._storms: list = []     # (at_step, fn, fired)
+        self._net: list = []        # dicts: kind/replica/count/prob/after_tokens
+        self._net_calls = 0         # connection-attempt counter (network clock)
         self.log: list = []         # (step, kind, detail)
 
     # -- schedule builders (chainable) -------------------------------------
@@ -105,6 +118,95 @@ class FaultInjector:
         — e.g. a burst of tenant-A ``submit()`` calls mid-flight."""
         self._storms.append([int(at_step), fire, False])
         return self
+
+    def refuse_connect(self, *, replica: Optional[str] = None,
+                       count: Optional[int] = 1,
+                       prob: Optional[float] = None) -> "FaultInjector":
+        """Raise ``ConnectionRefusedError`` on connection attempts to
+        ``replica`` (None = any): the next ``count`` attempts, or each
+        attempt with probability ``prob`` (seeded) — a replica that died
+        between scrapes, as the router's transport sees it."""
+        if (count is None) == (prob is None):
+            raise ValueError("pass exactly one of count= / prob=")
+        self._net.append(dict(kind="refuse_connect", replica=replica,
+                              count=count, prob=prob))
+        return self
+
+    def slow_replica(self, *, replica: Optional[str] = None,
+                     delay_s: float = 0.05, count: Optional[int] = None,
+                     prob: Optional[float] = None) -> "FaultInjector":
+        """Sleep ``delay_s`` before connections to ``replica`` complete
+        (a straggler host / congested NIC) — forever when neither
+        ``count`` nor ``prob`` is given."""
+        if count is not None and prob is not None:
+            raise ValueError("pass at most one of count= / prob=")
+        self._net.append(dict(kind="slow_replica", replica=replica,
+                              count=count, prob=prob,
+                              delay_s=float(delay_s)))
+        return self
+
+    def drop_stream(self, *, replica: Optional[str] = None,
+                    after_tokens: int = 3,
+                    count: Optional[int] = 1) -> "FaultInjector":
+        """Raise :class:`StreamDropped` once a stream from ``replica``
+        has delivered ``after_tokens`` tokens — the mid-stream death the
+        re-queue path must survive. Fires on the next ``count`` streams
+        (None = every stream)."""
+        self._net.append(dict(kind="drop_stream", replica=replica,
+                              count=count, after_tokens=int(after_tokens)))
+        return self
+
+    # -- router transport hooks ---------------------------------------------
+
+    def _net_fire(self, fault: dict) -> bool:
+        if fault.get("prob") is not None:
+            return self.rng.random() < fault["prob"]
+        if fault.get("count") is None:
+            return True
+        if fault["count"] <= 0:
+            return False
+        fault["count"] -= 1
+        return True
+
+    def before_connect(self, replica: str):
+        """Router hook, ahead of each connection attempt: scripted
+        refusals raise, slow-replica faults sleep. The attempt counter is
+        the network clock the log records against."""
+        self._net_calls += 1
+        for fault in self._net:
+            if fault["replica"] is not None and fault["replica"] != replica:
+                continue
+            if fault["kind"] == "slow_replica" and self._net_fire(fault):
+                self.log.append(
+                    (self._net_calls, "slow_replica", (replica, fault["delay_s"]))
+                )
+                self._sleep(fault["delay_s"])
+            elif fault["kind"] == "refuse_connect" and self._net_fire(fault):
+                self.log.append((self._net_calls, "refuse_connect", replica))
+                raise ConnectionRefusedError(
+                    f"injected connection refusal to replica {replica!r}"
+                )
+
+    def on_stream_event(self, replica: str, index: int):
+        """Router hook, per received stream token: an armed
+        ``drop_stream`` fault raises once ``index`` reaches its
+        ``after_tokens`` threshold."""
+        for fault in self._net:
+            if fault["kind"] != "drop_stream":
+                continue
+            if fault["replica"] is not None and fault["replica"] != replica:
+                continue
+            if index < fault["after_tokens"]:
+                continue
+            if fault["count"] is not None:
+                if fault["count"] <= 0:
+                    continue
+                fault["count"] -= 1
+            self.log.append((self._net_calls, "drop_stream", (replica, index)))
+            raise StreamDropped(
+                f"injected mid-stream drop from replica {replica!r} "
+                f"after {index} tokens"
+            )
 
     # -- engine hooks -------------------------------------------------------
 

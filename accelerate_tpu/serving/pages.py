@@ -204,6 +204,14 @@ class PrefixCache:
         or would cost more prefill dispatches than a cold admission, and
         the hit-ratio gauges must reflect the final decision)."""
         self.lookups += 1
+        return self.peek(prompt, limit)
+
+    def peek(self, prompt: np.ndarray, limit: Optional[int] = None):
+        """:meth:`lookup` without side effects: the hit/lookup gauges and
+        LRU recency stay untouched. The KV-handoff export path (a replica
+        shipping cached pages to a peer) and router introspection probe
+        with this — a probe is not serving traffic and must not skew the
+        hit-ratio gauges or LRU-protect an entry it never admitted."""
         n = int(prompt.size if limit is None else min(prompt.size, limit))
         for length in self._candidate_lengths():
             if length > n:
@@ -446,6 +454,46 @@ def fork_page(arena, src, dst):
         return jax.lax.dynamic_update_slice_in_dim(leaf, page, dst, axis=axis)
 
     return jax.tree_util.tree_map(copy, arena)
+
+
+def gather_pages(arena, page_ids):
+    """Host copies of physical pages ``page_ids`` from every K/V leaf, in
+    the order given — the KV-handoff export read. Returns a list of numpy
+    arrays (one per K/V leaf, arena flatten order) whose page axis holds
+    ``len(page_ids)`` entries; quantized arenas ship the int8/int4 payload
+    leaves and their fp32 scale leaves alike (same rank — see the module
+    note above ``_KV_NDIM``), so a handoff can never separate a payload
+    from its scales. One small gather dispatch per leaf (the full arena is
+    never device_get)."""
+    import jax
+    import jax.numpy as jnp
+
+    ids = jnp.asarray(list(page_ids), jnp.int32)
+    out = []
+    for leaf in jax.tree_util.tree_leaves(arena):
+        if not _is_kv(leaf):
+            continue
+        g = jnp.take(leaf, ids, axis=_page_axis(leaf))
+        out.append(np.asarray(jax.device_get(g)))
+    return out
+
+
+def install_page(arena, page_tree, dst):
+    """Write one physical page's worth of K/V (``page_tree``: the arena's
+    pytree with every K/V leaf replaced by a size-1 page slice; non-K/V
+    leaves are ignored) into page ``dst`` — the KV-handoff import write.
+    Traced ``dst``: one compiled program installs any page, so a warmed
+    engine imports handed-off pages with zero recompiles."""
+    import jax
+
+    def put(leaf, page):
+        if not _is_kv(leaf):
+            return leaf
+        return jax.lax.dynamic_update_slice_in_dim(
+            leaf, page.astype(leaf.dtype), dst, axis=_page_axis(leaf)
+        )
+
+    return jax.tree_util.tree_map(put, arena, page_tree)
 
 
 def set_table_row(tables, slot, row):

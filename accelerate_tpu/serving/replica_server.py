@@ -1,0 +1,390 @@
+"""One serving replica as an HTTP process: the engine behind a wire.
+
+:class:`ReplicaServer` wraps a live :class:`~.engine.ServingEngine` in a
+stdlib-HTTP JSONL surface — the unit the router (``serving/router.py``)
+places onto, fails over between, and scales elastically:
+
+- ``POST /v1/submit`` — queue one request; with ``"stream": true`` the
+  response is JSONL (``{"event": "token", ...}`` per emitted token, one
+  terminal ``{"event": "done", ...}``), else a single JSON document. A
+  connection that closes *without* the terminal event is the replica-
+  death signature the router re-queues on.
+- ``POST /v1/cancel`` — ``{request_id}``; the engine frees the slot and
+  pages at its next iteration (the PR 7 cancel path).
+- ``POST /v1/kv/export`` / ``POST /v1/kv/import`` — the KV handoff: a
+  finished prompt's prefix-cache pages ship VERBATIM (quantized
+  payload+scales pages, the PR 10 wire format) so prefill replicas hand
+  finished KV to decode replicas and a migrated session keeps its warm
+  cache. Import installs through a warmup-compiled program: zero
+  recompiles on the receiving replica.
+- ``GET /metrics`` — the standard Prometheus scrape (the engine's
+  telemetry session when attached, else a minimal engine-gauges shim),
+  which is exactly what the router's ``FleetCollector`` polls for
+  health + placement.
+- ``GET /v1/health`` — a one-shot JSON health/identity document.
+
+Lifecycle: ``start()`` runs the engine's scheduler loop on a background
+thread (all device dispatches stay on that one thread; the KV endpoints
+serialize against it with one lock). SIGTERM — with
+``handle_signals=True`` — triggers the PR 7 drain choreography:
+``request_drain()`` (flag-only, signal-safe), in-flight requests finish
+and their streams complete, the flight recorder dumps, the process
+exits cleanly. A *draining* replica still answers ``/metrics`` (the
+``serving/draining`` gauge is how the fleet health machine sees it) and
+still serves its in-flight streams; new submits shed with
+``shed_reason="draining"``.
+
+This module is jax-free at import (declared in ``analysis/hygiene.py``):
+it receives a built engine and never imports the engine module itself —
+a supervisor/CLI tier can import it to parse flags before paying jax.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from ..telemetry.exporter import prometheus_text
+
+
+class _EngineMetricsSession:
+    """Minimal scrape shim for an engine with no telemetry session:
+    ``prometheus_text`` needs ``rollup()``/``hists``/``alerts`` and a
+    freshness clock. Freshness tracks the engine loop's last iteration
+    (``_touch``), so a wedged loop still reads as a degrading replica."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.hists: dict = {}
+        self.alerts = None
+        self.last_sample_unix_s = time.time()
+
+    def _touch(self):
+        self.last_sample_unix_s = time.time()
+
+    def rollup(self) -> dict:
+        return self.engine.metrics()
+
+
+class ReplicaServer:
+    """HTTP wrapper around one live engine. ``name`` becomes the
+    engine's ``replica`` identity (stamped into every request record —
+    the trace-stitching key). ``port=0`` binds an ephemeral port; read
+    the resolved one from ``.port``."""
+
+    def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
+                 name: Optional[str] = None, handle_signals: bool = False):
+        import http.server
+
+        self.engine = engine
+        if name:
+            engine.replica = str(name)
+        self.name = engine.replica or f"replica@{port}"
+        self._session = (
+            engine.telemetry if engine.telemetry is not None
+            else _EngineMetricsSession(engine)
+        )
+        self._stop = False
+        self._dead = False          # hard-fail switch (kill drills)
+        self._drained = threading.Event()
+        self._engine_lock = threading.Lock()   # loop thread vs KV endpoints
+        self._live_lock = threading.Lock()
+        self._live: dict = {}       # str(request_id) -> Request
+        self._loop_thread: Optional[threading.Thread] = None
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            timeout = 30.0
+
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                server._get(self)
+
+            def do_POST(self):  # noqa: N802
+                server._post(self)
+
+            def log_message(self, *args):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name=f"att-replica-http-{self.name}", daemon=True,
+        )
+        if handle_signals:
+            self._install_signal_handler()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ReplicaServer":
+        """Serve: HTTP thread + the engine scheduler loop thread."""
+        self._http_thread.start()
+        if self._loop_thread is None:
+            self._loop_thread = threading.Thread(
+                target=self._loop, name=f"att-replica-loop-{self.name}",
+                daemon=True,
+            )
+            self._loop_thread.start()
+        return self
+
+    def _loop(self):
+        shim = self._session if isinstance(
+            self._session, _EngineMetricsSession
+        ) else None
+        while not self._stop:
+            with self._engine_lock:
+                busy = self.engine.step()
+            if shim is not None:
+                shim._touch()
+            if self.engine._draining and not self.engine._pending():
+                # drain complete: every request reached its outcome and
+                # every stream's terminal event is writable — record the
+                # flight bundle and let serve_until_drained() return
+                self.engine._flight_dump("replica_drain_complete")
+                self._drained.set()
+                return
+            if not busy:
+                time.sleep(0.001)
+
+    def serve_until_drained(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until a drain completes (the SIGTERM path's main-thread
+        wait). True when drained; False on timeout/stop."""
+        return self._drained.wait(timeout_s)
+
+    def request_drain(self):
+        """Stop admitting, finish in-flight, then the loop thread stops.
+        Safe from a signal handler (flag-only, like the engine's)."""
+        self.engine.request_drain()
+
+    def _install_signal_handler(self):
+        import signal
+
+        def on_sigterm(signum, frame):
+            self.request_drain()
+
+        try:
+            signal.signal(signal.SIGTERM, on_sigterm)
+        except ValueError:
+            pass  # not the main thread: the embedder owns signals
+
+    def close(self, drain_timeout_s: float = 5.0):
+        """Graceful stop: drain, wait for in-flight to finish, shut the
+        HTTP server down."""
+        if not self._dead:
+            self.engine.request_drain()
+            self._drained.wait(drain_timeout_s)
+        self._stop = True
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5.0)
+            self._loop_thread = None
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except OSError:
+            pass
+        if self._http_thread.is_alive():
+            self._http_thread.join(timeout=5.0)
+
+    def kill(self):
+        """Hard-fail NOW (the in-process stand-in for SIGKILL in kill
+        drills): the scheduler loop stops mid-whatever, every in-flight
+        stream breaks off without its terminal event, the listener
+        closes. No drain, no flight record — exactly what a dead process
+        looks like from the router's side."""
+        self._dead = True
+        self._stop = True
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except OSError:
+            pass
+
+    # -- handlers (each on its own daemon thread) ---------------------------
+
+    @staticmethod
+    def _read_json(handler) -> dict:
+        n = int(handler.headers.get("Content-Length") or 0)
+        body = handler.rfile.read(n) if n else b"{}"
+        return json.loads(body or b"{}")
+
+    @staticmethod
+    def _send_json(handler, payload, status: int = 200):
+        body = json.dumps(payload).encode()
+        handler.send_response(status)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _get(self, handler):
+        if self._dead:
+            return  # connection drops — a dead process answers nothing
+        if handler.path in ("/metrics", "/"):
+            body = prometheus_text(self._session).encode()
+            handler.send_response(200)
+            handler.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        elif handler.path == "/v1/health":
+            m = self.engine.metrics()
+            self._send_json(handler, {
+                "replica": self.name,
+                "draining": bool(m.get("serving/draining")),
+                "load_score": m.get("serving/load_score"),
+                "queue_depth": m.get("serving/queue_depth"),
+                "free_slots": m.get("serving/free_slots"),
+            })
+        else:
+            handler.send_error(404)
+
+    def _post(self, handler):
+        if self._dead:
+            return
+        try:
+            body = self._read_json(handler)
+        except ValueError:
+            handler.send_error(400, "bad json")
+            return
+        if handler.path == "/v1/submit":
+            self._handle_submit(handler, body)
+        elif handler.path == "/v1/cancel":
+            self._handle_cancel(handler, body)
+        elif handler.path == "/v1/kv/export":
+            self._handle_kv_export(handler, body)
+        elif handler.path == "/v1/kv/import":
+            self._handle_kv_import(handler, body)
+        else:
+            handler.send_error(404)
+
+    # -- submit / stream ----------------------------------------------------
+
+    def _handle_submit(self, handler, body: dict):
+        prompt = body.get("prompt") or []
+        if not prompt:
+            handler.send_error(400, "empty prompt")
+            return
+        try:
+            req = self.engine.submit(
+                [int(t) for t in prompt],
+                max_new_tokens=int(body.get("max_new_tokens") or 32),
+                seed=int(body.get("seed") or 0),
+                tenant=str(body.get("tenant") or "default"),
+                priority=int(body.get("priority") or 0),
+                timeout_s=body.get("timeout_s"),
+                request_id=body.get("request_id"),
+            )
+        except ValueError as e:
+            handler.send_error(400, str(e)[:200])
+            return
+        rid = str(req.id)
+        with self._live_lock:
+            self._live[rid] = req
+        try:
+            if body.get("stream", True):
+                self._stream_request(handler, req)
+            else:
+                self._await_request(handler, req)
+        finally:
+            with self._live_lock:
+                self._live.pop(rid, None)
+
+    def _done_event(self, req) -> dict:
+        return {
+            "event": "done", "request_id": req.id, "replica": self.name,
+            "outcome": req.outcome, "finish_reason": req.finish_reason,
+            "shed_reason": req.shed_reason,
+            "tokens": [int(t) for t in req.tokens],
+            "prefix_hit": int(req.prefix_hit),
+        }
+
+    def _stream_request(self, handler, req):
+        """JSONL token stream. Reads ``req.tokens`` incrementally off
+        the handler thread (list append is atomic; the engine loop owns
+        the writes) — no callback into the engine, so a slow client can
+        never stall the scheduler loop. A hard-failed server breaks the
+        stream off with no terminal event — the router's re-queue
+        trigger."""
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/jsonl")
+        handler.end_headers()
+        sent = 0
+        try:
+            while True:
+                if self._dead:
+                    return  # mid-stream drop: connection closes, no "done"
+                n = len(req.tokens)
+                while sent < n:
+                    line = json.dumps({
+                        "event": "token", "i": sent,
+                        "token": int(req.tokens[sent]),
+                        "request_id": req.id, "replica": self.name,
+                    })
+                    handler.wfile.write((line + "\n").encode())
+                    sent += 1
+                handler.wfile.flush()
+                if req.done and sent >= len(req.tokens):
+                    handler.wfile.write(
+                        (json.dumps(self._done_event(req)) + "\n").encode()
+                    )
+                    handler.wfile.flush()
+                    return
+                time.sleep(0.002)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client (or router hop) went away: free the slot now
+            req.cancel()
+
+    def _await_request(self, handler, req):
+        while not req.done:
+            if self._dead:
+                return
+            time.sleep(0.002)
+        self._send_json(handler, self._done_event(req))
+
+    def _handle_cancel(self, handler, body: dict):
+        rid = str(body.get("request_id"))
+        with self._live_lock:
+            req = self._live.get(rid)
+        if req is None:
+            self._send_json(handler, {"ok": False, "error": "unknown request"},
+                            status=404)
+            return
+        self._send_json(handler, {"ok": req.cancel()})
+
+    # -- KV handoff ---------------------------------------------------------
+
+    def _handle_kv_export(self, handler, body: dict):
+        tokens = body.get("tokens") or []
+        try:
+            with self._engine_lock:
+                handoff = self.engine.export_prefix_kv(
+                    [int(t) for t in tokens]
+                )
+        except ValueError as e:
+            handler.send_error(409, str(e)[:200])
+            return
+        if handoff is None:
+            self._send_json(handler, {"error": "prefix not cached"},
+                            status=404)
+            return
+        self._send_json(handler, handoff)
+
+    def _handle_kv_import(self, handler, body: dict):
+        try:
+            with self._engine_lock:
+                installed = self.engine.import_prefix_kv(body)
+        except ValueError as e:
+            handler.send_error(409, str(e)[:200])
+            return
+        self._send_json(handler, {"installed_tokens": int(installed),
+                                  "replica": self.name})

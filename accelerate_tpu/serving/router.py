@@ -1,0 +1,776 @@
+"""Multi-replica serving router: placement, failover, re-queue.
+
+One :class:`~.engine.ServingEngine` process serves one host's devices; a
+production deployment is N replica processes behind a front door. This
+module is that front door, and its headline property is **robustness**:
+kill any replica mid-burst and every request still reaches a definite,
+token-exact outcome. Plain stdlib — no jax/flax/numpy (declared in
+``analysis/hygiene.py``'s jax-free set): the router runs on a box with
+no accelerator stack.
+
+- **placement** — least-loaded off the PR 11 signal contract: a
+  :class:`~..telemetry.fleet.FleetCollector` polls every replica's
+  ``/metrics`` scrape and ``placement_view()`` ranks them by
+  ``serving/load_score``; **session affinity** pins a ``session`` id to
+  the replica that served it last (its prefix-cache pages make repeat
+  TTFT near-zero), falling back to least-loaded — and migrating the
+  session's KV through the handoff endpoints — when that replica drains
+  or dies.
+- **failover + re-queue** — a connection refusal, a read timeout, or a
+  stream that ends without a terminal event marks the replica failed
+  (excluded immediately, before the health machine's next poll
+  confirms) and re-queues the request onto a surviving replica with the
+  same ``request_id``, so the per-replica request logs stitch into one
+  hop-by-hop timeline (``accelerate-tpu trace summary --request-id``).
+  Tokens already streamed are never re-emitted: the replay is
+  token-exact by engine determinism (same seed, same prompt), and the
+  router skips the prefix it already delivered.
+- **backoff** — capped exponential with deterministic seeded jitter
+  (:func:`backoff_schedule`): the schedule is a pure function of
+  ``(backoff_seed, request_id)``, so a failing drill replays the exact
+  same waits.
+- **bounded queues** — admission past ``max_inflight`` sheds with
+  ``shed_reason="router_queue_full"`` (a value, not an exception, same
+  as the engine's admission control); no-replica and retries-exhausted
+  paths shed too. The router never stalls a caller indefinitely.
+- **elastic membership** — replicas register/deregister at runtime
+  (HTTP ``/v1/register`` // ``/v1/deregister`` or
+  :meth:`Router.register_replica`); a draining replica takes no new
+  placements but stays visible (``placement_view(include_draining=
+  True)``) so its in-flight streams finish and its cached KV can be
+  exported.
+
+Fault injection: the PR 7 :class:`~.faults.FaultInjector` gained
+network-level faults (connection-refused, slow-replica, mid-stream
+drop); pass one as ``Router(..., faults=...)`` and the transport layer
+consults it — the same seeded injector drives single-engine scheduler
+drills and multi-replica kill drills.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..telemetry.fleet import DOWN_STATES, FleetCollector
+from .faults import StreamDropped
+
+# terminal router shed reasons (same bounded-vocabulary contract as the
+# engine scheduler's SHED_* constants — dashboards group on these)
+SHED_ROUTER_QUEUE_FULL = "router_queue_full"  # max_inflight at submit
+SHED_NO_REPLICAS = "no_replicas"              # nothing placeable, ever
+SHED_RETRIES_EXHAUSTED = "retries_exhausted"  # every hop failed
+
+
+def backoff_schedule(seed, request_id, attempts: int, *,
+                     base_s: float = 0.05, cap_s: float = 2.0) -> list:
+    """The re-queue backoff schedule: capped exponential with
+    deterministic seeded jitter. A pure function of
+    ``(seed, request_id)`` — the same request under the same router
+    config always waits the same intervals, so a failing burst drill is
+    a repro, not an anecdote. Jitter spans [0.5x, 1x] of the capped
+    exponential term (never zero: a thundering re-queue herd after a
+    replica death must decorrelate)."""
+    rng = random.Random(f"{seed}/{request_id}")
+    out = []
+    for i in range(attempts):
+        base = min(float(cap_s), float(base_s) * (2.0 ** i))
+        out.append(base * (0.5 + 0.5 * rng.random()))
+    return out
+
+
+@dataclass
+class RouterConfig:
+    """Knobs for :class:`Router` (docs/serving.md has the tuning
+    guide)."""
+
+    max_inflight: int = 64            # bounded router queue; past it -> shed
+    max_retries: int = 4              # re-queue attempts after the first hop
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    backoff_seed: int = 0
+    request_timeout_s: Optional[float] = None  # wall from submit to cancel
+    connect_timeout_s: float = 5.0
+    read_timeout_s: float = 60.0      # per-read; a silent replica is a failure
+    poll_interval_s: float = 0.25     # health/placement scrape cadence
+    failure_cooldown_s: float = 10.0  # in-flight failure excludes this long
+    affinity: bool = True             # session -> last-replica stickiness
+    migrate_session_kv: bool = True   # KV handoff when a session moves
+
+
+@dataclass(eq=False)
+class RouterRequest:
+    """One logical request and its hop history (``eq=False`` for the
+    same identity-not-value reason as the engine's ``Request``). The
+    ``request_id`` is stable across hops — every replica's request log
+    carries it, which is what makes the re-queue path observable end to
+    end."""
+
+    id: object
+    prompt: list
+    max_new_tokens: int
+    seed: int
+    session: Optional[str] = None
+    tenant: str = "default"
+    priority: int = 0
+
+    tokens: list = field(default_factory=list)
+    hops: list = field(default_factory=list)   # {replica, t_unix_s, error?}
+    replica: Optional[str] = None              # who finished it
+    outcome: Optional[str] = None              # finished | shed | cancelled
+    finish_reason: Optional[str] = None
+    shed_reason: Optional[str] = None
+    requeues: int = 0
+    prefix_hit: int = 0
+    submit_t: float = 0.0
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.outcome is not None
+
+
+class HttpTransport:
+    """The stdlib replica transport: JSONL streaming submit plus plain
+    JSON POSTs (cancel, KV export/import). Injectable — the jax-free
+    router unit tests script a fake; the drills run this one."""
+
+    def __init__(self, *, connect_timeout_s: float = 5.0,
+                 read_timeout_s: float = 60.0):
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.read_timeout_s = float(read_timeout_s)
+
+    def _conn(self, base_url: str):
+        import http.client
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(base_url)
+        if parts.scheme not in ("http", ""):
+            raise ValueError(f"replica transport is http-only, got {base_url!r}")
+        host = parts.hostname or parts.path.split("/")[0]
+        return http.client.HTTPConnection(
+            host, parts.port or 80, timeout=self.connect_timeout_s
+        )
+
+    def stream_submit(self, base_url: str, payload: dict, *,
+                      on_event: Callable[[dict], None]) -> dict:
+        """POST ``/v1/submit`` and feed each JSONL event to
+        ``on_event``; returns the terminal ``done`` event. EOF before a
+        terminal event raises :class:`StreamDropped` — the caller's
+        re-queue trigger."""
+        conn = self._conn(base_url)
+        try:
+            body = json.dumps(payload).encode()
+            conn.request("POST", "/v1/submit", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise ConnectionError(
+                    f"replica {base_url} answered {resp.status} to submit"
+                )
+            if conn.sock is not None:
+                # a replica that stops emitting (wedged, paused mid-kill)
+                # is a failure, not a hang: bound every read
+                conn.sock.settimeout(self.read_timeout_s)
+            while True:
+                line = resp.readline()
+                if not line:
+                    raise StreamDropped(
+                        f"stream from {base_url} ended without a terminal event"
+                    )
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    # a torn final line IS the mid-write death signature
+                    raise StreamDropped(
+                        f"torn stream line from {base_url}"
+                    ) from None
+                on_event(event)
+                if event.get("event") == "done":
+                    return event
+        finally:
+            conn.close()
+
+    def post_json(self, base_url: str, path: str, payload: dict) -> dict:
+        conn = self._conn(base_url)
+        try:
+            conn.request("POST", path, body=json.dumps(payload).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status >= 400:
+                raise ConnectionError(
+                    f"replica {base_url}{path} answered {resp.status}: "
+                    f"{data[:200]!r}"
+                )
+            return json.loads(data) if data else {}
+        finally:
+            conn.close()
+
+
+class Router:
+    """Least-loaded + session-affinity placement with failover/re-queue
+    over N replica servers. ``replicas`` is ``{name: base_url}`` (or
+    ``(name, url)`` pairs); more join/leave at runtime via
+    :meth:`register_replica` / :meth:`deregister_replica`.
+
+    ``submit()`` is synchronous (the HTTP front door runs it on its
+    handler threads; drills run it on their own): it places, streams,
+    and — on a replica failure — re-queues with the failed replica
+    excluded, until the request reaches exactly one terminal outcome.
+    """
+
+    def __init__(self, replicas=None, *, config: Optional[RouterConfig] = None,
+                 transport=None, faults=None, fetch_fn=None,
+                 clock: Callable[[], float] = time.time,
+                 collector: Optional[FleetCollector] = None):
+        self.config = config or RouterConfig()
+        self._clock = clock
+        pairs = []
+        if replicas:
+            items = replicas.items() if isinstance(replicas, dict) else replicas
+            pairs = [(str(n), str(u).rstrip("/")) for n, u in items]
+        self._lock = threading.Lock()
+        self._replicas = dict(pairs)           # name -> base_url
+        self._sessions: dict = {}              # session -> replica name
+        self._failed: dict = {}                # name -> last in-flight failure t
+        self._inflight = 0
+        self._next_id = 0
+        self.transport = transport or HttpTransport(
+            connect_timeout_s=self.config.connect_timeout_s,
+            read_timeout_s=self.config.read_timeout_s,
+        )
+        self._faults = faults
+        self.collector = collector or FleetCollector(
+            [(n, self._metrics_target(u)) for n, u in pairs],
+            poll_interval_s=self.config.poll_interval_s,
+            fetch_fn=fetch_fn, clock=clock,
+        )
+        # counters (the router's own gauge contract, /metrics-rendered)
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.requests_shed = 0
+        self.requests_cancelled = 0
+        self.requeues = 0           # failed HOPS (a request can add >1)
+        self.requests_requeued = 0  # REQUESTS that survived >=1 failed hop
+        self.requeue_success = 0    # ...and still finished
+        self.kv_migrations = 0
+        self.replica_failures: dict = {}       # name -> count
+
+    @staticmethod
+    def _metrics_target(base_url: str) -> str:
+        return base_url.rstrip("/") + "/metrics"
+
+    # -- membership ---------------------------------------------------------
+
+    def register_replica(self, name: str, base_url: str) -> None:
+        """Elastic join: the replica enters placement as soon as its
+        first scrape lands (state machine: starting -> healthy)."""
+        name, base_url = str(name), str(base_url).rstrip("/")
+        with self._lock:
+            self._replicas[name] = base_url
+            self._failed.pop(name, None)
+        self.collector.add_replica(name, self._metrics_target(base_url))
+
+    def deregister_replica(self, name: str) -> bool:
+        """Elastic leave: gone from placement immediately. In-flight
+        streams on the replica are unaffected (their connections stand);
+        sticky sessions fall back to least-loaded on their next
+        request."""
+        name = str(name)
+        with self._lock:
+            known = self._replicas.pop(name, None) is not None
+            self._failed.pop(name, None)
+            for session, replica in list(self._sessions.items()):
+                if replica == name:
+                    del self._sessions[session]
+        self.collector.remove_replica(name)
+        return known
+
+    def start(self) -> "Router":
+        """Run the health/placement poll on its background cadence."""
+        self.collector.start()
+        return self
+
+    def close(self):
+        self.collector.close()
+
+    # -- placement ----------------------------------------------------------
+
+    def _failed_now(self, now: float) -> set:
+        with self._lock:
+            return {
+                n for n, t in self._failed.items()
+                if now - t < self.config.failure_cooldown_s
+            }
+
+    def _note_failure(self, name: str, now: float):
+        with self._lock:
+            self._failed[name] = now
+            self.replica_failures[name] = self.replica_failures.get(name, 0) + 1
+
+    def candidates(self, session: Optional[str] = None, exclude=()) -> list:
+        """Placement order for one hop: the collector's score-ranked
+        placeable view, minus excluded/recently-failed replicas, with
+        the session's sticky replica promoted to the front when it is
+        still placeable. Returns replica names."""
+        now = self._clock()
+        rows = self.collector.placement_view()
+        failed = self._failed_now(now)
+        with self._lock:
+            known = set(self._replicas)
+            sticky = self._sessions.get(session) if session else None
+        names = [
+            r["replica"] for r in rows
+            if r["replica"] in known
+            and r["replica"] not in exclude
+            and r["replica"] not in failed
+        ]
+        if self.config.affinity and sticky in names:
+            names.remove(sticky)
+            names.insert(0, sticky)
+        return names
+
+    def _replica_url(self, name: str) -> Optional[str]:
+        with self._lock:
+            return self._replicas.get(name)
+
+    def _sticky_source(self, session: Optional[str], target: str):
+        """(name, url) of the session's previous replica when the
+        session is migrating off it and its KV may still be exportable
+        (reachable or draining — NOT dead), else None."""
+        if not session or not self.config.migrate_session_kv:
+            return None
+        with self._lock:
+            sticky = self._sessions.get(session)
+            url = self._replicas.get(sticky) if sticky else None
+        if sticky is None or sticky == target or url is None:
+            return None
+        for row in self.collector.placement_view(include_unplaceable=True):
+            if row["replica"] != sticky:
+                continue
+            if row["state"] in DOWN_STATES:
+                return None
+            return sticky, url
+        return None
+
+    def _migrate_session_kv(self, req: RouterRequest, target: str,
+                            target_url: str):
+        """Best-effort KV handoff when a sticky session moves: export
+        the prompt's cached pages from the old replica, import into the
+        new one, so the migrated session's next admission is still a
+        prefix hit. Failure is absorbed — the request just pays a cold
+        prefill."""
+        src = self._sticky_source(req.session, target)
+        if src is None:
+            return
+        src_name, src_url = src
+        try:
+            handoff = self.transport.post_json(
+                src_url, "/v1/kv/export", {"tokens": list(req.prompt)}
+            )
+            if handoff and handoff.get("n_pages"):
+                out = self.transport.post_json(
+                    target_url, "/v1/kv/import", handoff
+                )
+                if out.get("installed_tokens"):
+                    with self._lock:
+                        self.kv_migrations += 1
+                    req.hops.append({
+                        "replica": target, "t_unix_s": round(self._clock(), 3),
+                        "kv_migrated_from": src_name,
+                        "kv_tokens": int(out["installed_tokens"]),
+                    })
+        except (OSError, ConnectionError, ValueError):
+            pass
+
+    # -- the request path ---------------------------------------------------
+
+    def submit(self, prompt, *, max_new_tokens: int = 32, seed: int = 0,
+               session: Optional[str] = None, tenant: str = "default",
+               priority: int = 0, request_id=None,
+               timeout_s: Optional[float] = None,
+               on_token: Optional[Callable] = None) -> RouterRequest:
+        """Route one request to completion. Returns the terminal
+        :class:`RouterRequest` — outcome ``finished``, ``shed`` (with
+        ``shed_reason``), or ``cancelled`` (timeout); never raises for a
+        replica-side failure and never hangs (bounded retries, bounded
+        waits). ``on_token(token, req)`` fires once per emitted token
+        across all hops — a re-queued replay's already-delivered prefix
+        is skipped, not re-emitted."""
+        with self._lock:
+            self.requests_submitted += 1
+            if request_id is None:
+                request_id = f"r{self._next_id}"
+                self._next_id += 1
+            admitted = self._inflight < max(0, int(self.config.max_inflight))
+            if admitted:
+                self._inflight += 1
+        req = RouterRequest(
+            id=request_id, prompt=[int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens), seed=int(seed),
+            session=session, tenant=str(tenant or "default"),
+            priority=int(priority),
+        )
+        req.submit_t = self._clock()
+        if not admitted:
+            self._shed(req, SHED_ROUTER_QUEUE_FULL)
+            return req
+        try:
+            self._route(req, timeout_s, on_token)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+        return req
+
+    def _shed(self, req: RouterRequest, reason: str):
+        req.outcome = "shed"
+        req.finish_reason = "shed"
+        req.shed_reason = reason
+        req.finish_t = self._clock()
+        with self._lock:
+            self.requests_shed += 1
+            if any("error" in h for h in req.hops):
+                self.requests_requeued += 1
+
+    def _deadline(self, req: RouterRequest, timeout_s) -> Optional[float]:
+        timeout_s = timeout_s if timeout_s is not None \
+            else self.config.request_timeout_s
+        return req.submit_t + timeout_s if timeout_s is not None else None
+
+    def _route(self, req: RouterRequest, timeout_s, on_token):
+        cfg = self.config
+        delays = backoff_schedule(
+            cfg.backoff_seed, req.id, cfg.max_retries + 1,
+            base_s=cfg.backoff_base_s, cap_s=cfg.backoff_cap_s,
+        )
+        deadline = self._deadline(req, timeout_s)
+        excluded: list = []
+        failures = 0
+        while True:
+            now = self._clock()
+            if deadline is not None and now >= deadline:
+                req.outcome = "cancelled"
+                req.finish_reason = "timeout"
+                req.finish_t = now
+                with self._lock:
+                    self.requests_cancelled += 1
+                    if any("error" in h for h in req.hops):
+                        self.requests_requeued += 1
+                return
+            names = self.candidates(req.session, exclude=excluded)
+            if not names:
+                with self._lock:
+                    any_known = bool(self._replicas)
+                if not any_known or failures > cfg.max_retries:
+                    # keyed on the hop history, not the (clearable)
+                    # exclusion list: a request whose hops failed is
+                    # retries_exhausted even after an exclusion reset
+                    self._shed(
+                        req,
+                        SHED_RETRIES_EXHAUSTED
+                        if any("error" in h for h in req.hops)
+                        else SHED_NO_REPLICAS,
+                    )
+                    return
+                # replicas exist but none is placeable right now (all
+                # excluded / scrapes pending): back off, refresh health,
+                # then drop the per-request exclusions — the fleet view
+                # has caught up, so a genuinely-bad replica stays out
+                # via its health state / failure cooldown while a
+                # recovered one becomes retryable again
+                time.sleep(delays[min(failures, len(delays) - 1)])
+                failures += 1
+                self.collector.poll_once()
+                del excluded[:]
+                continue
+            target = names[0]
+            url = self._replica_url(target)
+            if url is None:
+                excluded.append(target)
+                continue
+            if req.prompt and not req.tokens:
+                self._migrate_session_kv(req, target, url)
+            req.hops.append(
+                {"replica": target, "t_unix_s": round(self._clock(), 3)}
+            )
+            hop = req.hops[-1]
+            try:
+                if self._faults is not None:
+                    self._faults.before_connect(target)
+                done = self.transport.stream_submit(
+                    url, self._hop_payload(req, deadline),
+                    on_event=lambda evt: self._on_event(
+                        req, target, evt, on_token
+                    ),
+                )
+            except (OSError, ConnectionError, StreamDropped) as e:
+                hop["error"] = f"{type(e).__name__}: {e}"
+                self._note_failure(target, self._clock())
+                excluded.append(target)
+                failures += 1
+                with self._lock:
+                    self.requeues += 1
+                if failures > cfg.max_retries:
+                    self._shed(req, SHED_RETRIES_EXHAUSTED)
+                    return
+                time.sleep(delays[min(failures - 1, len(delays) - 1)])
+                continue
+            # terminal event from the replica
+            outcome = str(done.get("outcome") or "finished")
+            if outcome == "shed" and done.get("shed_reason") == "draining":
+                # the replica started draining between the scrape and our
+                # connect: not a failure, just not placeable — try the
+                # next one without burning a failure budget slot
+                hop["error"] = "shed: draining"
+                excluded.append(target)
+                continue
+            req.replica = target
+            req.outcome = outcome
+            req.finish_reason = done.get("finish_reason")
+            req.shed_reason = done.get("shed_reason")
+            req.prefix_hit = int(done.get("prefix_hit") or 0)
+            req.finish_t = self._clock()
+            with self._lock:
+                crossed_failure = any("error" in h for h in req.hops[:-1])
+                if crossed_failure:
+                    self.requests_requeued += 1
+                if outcome == "finished":
+                    self.requests_completed += 1
+                    if crossed_failure:
+                        # survived >=1 failed hop AND finished: the
+                        # numerator of router_requeue_success_rate
+                        self.requeue_success += 1
+                elif outcome == "shed":
+                    self.requests_shed += 1
+                else:
+                    self.requests_cancelled += 1
+                if req.session and outcome == "finished":
+                    self._sessions[req.session] = target
+            return
+
+    def _hop_payload(self, req: RouterRequest,
+                     deadline: Optional[float]) -> dict:
+        payload = {
+            "prompt": req.prompt,
+            "max_new_tokens": req.max_new_tokens,
+            "seed": req.seed,
+            "tenant": req.tenant,
+            "priority": req.priority,
+            "request_id": req.id,
+            "stream": True,
+        }
+        if deadline is not None:
+            # enforce the wall INSIDE the hop too: the replica's own
+            # timeout path cancels mid-stream (terminal event outcome
+            # "cancelled"), so a healthy-but-slow stream cannot outlive
+            # the caller's budget between the router's loop-top checks
+            payload["timeout_s"] = max(0.05, deadline - self._clock())
+        return payload
+
+    def _on_event(self, req: RouterRequest, replica: str, event: dict,
+                  on_token):
+        if self._faults is not None and event.get("event") == "token":
+            self._faults.on_stream_event(replica, int(event.get("i", 0)))
+        if event.get("event") != "token":
+            return
+        i = int(event["i"])
+        if i < len(req.tokens):
+            return  # replayed prefix after a re-queue: already delivered
+        token = int(event["token"])
+        req.tokens.append(token)
+        if req.first_token_t is None:
+            req.first_token_t = self._clock()
+        if on_token is not None:
+            on_token(token, req)
+
+    # -- introspection ------------------------------------------------------
+
+    def placement(self, include_draining: bool = True) -> list:
+        """The ranked placement snapshot the router is acting on (see
+        ``FleetCollector.placement_view``; draining replicas included by
+        default — they still serve their in-flight streams)."""
+        return self.collector.placement_view(include_draining=include_draining)
+
+    def metrics(self) -> dict:
+        with self._lock:
+            out = {
+                "router/replicas": len(self._replicas),
+                "router/inflight": self._inflight,
+                "router/requests_submitted": self.requests_submitted,
+                "router/requests_completed": self.requests_completed,
+                "router/requests_shed": self.requests_shed,
+                "router/requests_cancelled": self.requests_cancelled,
+                "router/requeues": self.requeues,
+                "router/requests_requeued": self.requests_requeued,
+                "router/requeue_success": self.requeue_success,
+                "router/kv_migrations": self.kv_migrations,
+                "router/sessions": len(self._sessions),
+            }
+            for name, n in sorted(self.replica_failures.items()):
+                out[f"router/failures/{name}"] = n
+        return out
+
+
+class _RouterMetricsSession:
+    """`prometheus_text` shim over the router's counters (the same
+    pattern as the replica server's engine-gauges shim)."""
+
+    def __init__(self, router: Router):
+        self.router = router
+        self.hists: dict = {}
+        self.alerts = None
+        self.last_sample_unix_s = None  # counters are live, not sampled
+
+    def rollup(self) -> dict:
+        return self.router.metrics()
+
+
+class RouterServer:
+    """The stdlib-HTTP/JSONL front door over a :class:`Router`:
+
+    - ``POST /v1/submit`` — body ``{prompt, max_new_tokens, seed,
+      session?, tenant?, priority?, request_id?, timeout_s?}``; streams
+      ``{"event": "token", ...}`` JSONL lines and one terminal
+      ``{"event": "done", ...}`` (failover happens underneath — the
+      client sees one uninterrupted, token-exact stream);
+    - ``POST /v1/register`` / ``POST /v1/deregister`` — elastic replica
+      membership (``{name, url}`` / ``{name}``);
+    - ``GET /v1/placement`` — the ranked placement snapshot (JSON);
+    - ``GET /metrics`` — the router's own counters as Prometheus text.
+    """
+
+    def __init__(self, router: Router, *, host: str = "127.0.0.1",
+                 port: int = 0):
+        import http.server
+
+        self.router = router
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            timeout = 30.0
+
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                server._get(self)
+
+            def do_POST(self):  # noqa: N802
+                server._post(self)
+
+            def log_message(self, *args):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="att-router", daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    # -- handlers (each runs on its own daemon thread) ----------------------
+
+    @staticmethod
+    def _read_json(handler) -> dict:
+        n = int(handler.headers.get("Content-Length") or 0)
+        body = handler.rfile.read(n) if n else b"{}"
+        return json.loads(body or b"{}")
+
+    @staticmethod
+    def _send_json(handler, payload: dict, status: int = 200):
+        body = json.dumps(payload).encode()
+        handler.send_response(status)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _get(self, handler):
+        if handler.path == "/v1/placement":
+            self._send_json(handler, {"placement": self.router.placement()})
+        elif handler.path in ("/metrics", "/"):
+            # ride THE exposition renderer (telemetry/exporter) through a
+            # rollup shim, not a hand-rolled formatter: name sanitization
+            # and format fixes must live in exactly one place
+            from ..telemetry.exporter import prometheus_text
+
+            body = prometheus_text(_RouterMetricsSession(self.router)).encode()
+            handler.send_response(200)
+            handler.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        else:
+            handler.send_error(404)
+
+    def _post(self, handler):
+        try:
+            body = self._read_json(handler)
+        except ValueError:
+            handler.send_error(400, "bad json")
+            return
+        if handler.path == "/v1/register":
+            self.router.register_replica(body["name"], body["url"])
+            self._send_json(handler, {"ok": True})
+        elif handler.path == "/v1/deregister":
+            known = self.router.deregister_replica(body.get("name", ""))
+            self._send_json(handler, {"ok": True, "known": known})
+        elif handler.path == "/v1/submit":
+            self._submit(handler, body)
+        else:
+            handler.send_error(404)
+
+    def _submit(self, handler, body: dict):
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/jsonl")
+        handler.end_headers()
+        client_gone = []
+
+        def emit(evt: dict):
+            # a vanished client must not read as a REPLICA failure (the
+            # hop keeps finishing replica-side); swallow and stop writing
+            if client_gone:
+                return
+            try:
+                handler.wfile.write((json.dumps(evt) + "\n").encode())
+                handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                client_gone.append(True)
+
+        def on_token(token, req):
+            emit({"event": "token", "i": len(req.tokens) - 1, "token": token,
+                  "request_id": req.id})
+
+        req = self.router.submit(
+            [int(t) for t in body.get("prompt") or []],
+            max_new_tokens=int(body.get("max_new_tokens") or 32),
+            seed=int(body.get("seed") or 0),
+            session=body.get("session"),
+            tenant=str(body.get("tenant") or "default"),
+            priority=int(body.get("priority") or 0),
+            request_id=body.get("request_id"),
+            timeout_s=body.get("timeout_s"),
+            on_token=on_token,
+        )
+        emit({
+            "event": "done", "request_id": req.id,
+            "outcome": req.outcome, "finish_reason": req.finish_reason,
+            "shed_reason": req.shed_reason, "replica": req.replica,
+            "requeues": sum(1 for h in req.hops if "error" in h),
+            "hops": req.hops, "tokens": req.tokens,
+            "prefix_hit": req.prefix_hit,
+        })

@@ -310,6 +310,7 @@ _COUNTER_KEYS = frozenset({
     "serving/prefill_chunks_skipped", "serving/page_forks",
     "serving/prefix_hit_tokens", "serving/admission_recompiles",
     "serving/itl_slo_breaches", "serving/itl_budget_adjustments",
+    "serving/kv_pages_exported", "serving/kv_pages_imported",
     "sys/recompiles_diagnosed", "fleet/scrapes_ok", "fleet/scrapes_failed",
 })
 _MEAN_SUFFIXES = ("_frac", "_ratio", "_pct", "occupancy", "_rate",
@@ -497,8 +498,9 @@ class FleetCollector:
                     pairs.append((str(t[0]), str(t[1])))
                 else:
                     pairs.append((_replica_name(str(t), i), str(t)))
-        if not pairs:
-            raise ValueError("FleetCollector needs at least one target")
+        # an EMPTY target list is legal: an elastic deployment's router
+        # starts the collector before any replica has registered and
+        # grows it through add_replica() as they join
         names = [n for n, _ in pairs]
         if len(names) != len(set(names)):
             raise ValueError(f"duplicate replica names in {names}")
@@ -549,10 +551,44 @@ class FleetCollector:
             from concurrent.futures import ThreadPoolExecutor
 
             self._executor = ThreadPoolExecutor(
-                max_workers=min(16, len(self.replicas)),
+                max_workers=min(16, max(1, len(self.replicas))),
                 thread_name_prefix="att-fleet-scrape",
             )
         return self._executor
+
+    # -- elastic membership (router join/leave) -----------------------------
+
+    def add_replica(self, name: str, target: str) -> None:
+        """Register a replica mid-flight (elastic scale-out): it enters
+        the state machine at ``starting`` and joins the next poll. A
+        re-registration under the same name refreshes the target but
+        keeps the existing scrape history."""
+        name, target = str(name), str(target)
+        now = self._clock()
+        with self._lock:
+            r = self.replicas.get(name)
+            if r is not None:
+                r.target = target
+                return
+            self.replicas[name] = ReplicaStatus(
+                name=name, target=target, since=now, registered_t=now
+            )
+            # the scrape pool is sized to the membership; a pool built
+            # when the fleet was smaller would serialize scrapes (K
+            # unreachable replicas -> K x timeout per poll, exactly when
+            # the plane must stay responsive) — rebuild it lazily
+            stale = self._executor
+            self._executor = None
+        if stale is not None:
+            stale.shutdown(wait=False)
+
+    def remove_replica(self, name: str) -> bool:
+        """Deregister a replica (elastic scale-in / permanent death):
+        dropped from placement and future polls immediately. Its
+        last-known counters leave the fleet aggregate — deregistration
+        means 'forget it', unlike a death, which conserves them."""
+        with self._lock:
+            return self.replicas.pop(str(name), None) is not None
 
     # -- scraping ----------------------------------------------------------
 
@@ -686,15 +722,25 @@ class FleetCollector:
         # poll interval the moment two replicas die, which is exactly
         # when the plane must stay responsive. A pool bounds the pass at
         # ~max(timeout), and the lock stays free for placement_view()
-        # readers. The replica set is fixed after __init__, so iterating
-        # it unlocked is safe.
+        # readers. The replica set can change elastically (add_replica /
+        # remove_replica), so the pass runs over a locked snapshot and
+        # re-checks membership before folding each result back in.
         def one(r):
             try:
                 return (r.name, self._fetch(r.target), None)
             except Exception as e:
                 return (r.name, None, e)
 
-        replicas = list(self.replicas.values())
+        with self._lock:
+            replicas = list(self.replicas.values())
+        if not replicas:
+            with self._lock:
+                self.polls += 1
+                merged = self._merged_sample(now)
+                self._last_merged = merged
+            t = self.timeline.add_sample(merged, now=now)
+            self.alerts.evaluate(now=t)
+            return merged
         if len(replicas) == 1:
             results = [one(replicas[0])]
         else:
@@ -702,7 +748,9 @@ class FleetCollector:
         with self._lock:
             self.polls += 1
             for name, snap, err in results:
-                r = self.replicas[name]
+                r = self.replicas.get(name)
+                if r is None:
+                    continue  # deregistered while the scrape was in flight
                 if err is not None:
                     self._on_scrape_fail(r, err, now)
                 else:
@@ -760,12 +808,21 @@ class FleetCollector:
             return dict(self._last_merged)
 
     def placement_view(self, include_unplaceable: bool = False,
-                       now: Optional[float] = None) -> list:
+                       now: Optional[float] = None,
+                       include_draining: bool = False) -> list:
         """The ranked per-replica placement snapshot — THE router input.
         Rows ascend by ``load_score`` (lower = place here first); a
         replica that is draining, unreachable, or dead is dropped (or
         trails with ``placeable: False`` under ``include_unplaceable``),
-        so one poll interval after a kill the victim is gone."""
+        so one poll interval after a kill the victim is gone.
+
+        ``include_draining=True`` keeps DRAINING replicas in the view
+        (trailing, still ``placeable: False``): a draining replica takes
+        no *new* placements but keeps serving its in-flight streams, and
+        a router that dropped it entirely would orphan those streams —
+        it still needs the replica's target to route stream reads (and
+        as the KV-handoff source when a sticky session migrates off
+        it)."""
         now = self._clock() if now is None else float(now)
         rows = []
         with self._lock:
@@ -801,7 +858,12 @@ class FleetCollector:
         ))
         if include_unplaceable:
             return rows
-        return [row for row in rows if row["placeable"]]
+        return [
+            row for row in rows
+            if row["placeable"]
+            or (include_draining
+                and (row["draining"] or row["state"] == DRAINING))
+        ]
 
     def health(self, now: Optional[float] = None) -> dict:
         now = self._clock() if now is None else float(now)

@@ -1418,6 +1418,147 @@ def _serving_isolation_bench(cfg, prompt_len, *, page_size=16, num_slots=2,
     }
 
 
+def _router_failover_bench(cfg, prompt_len, *, page_size=16, num_slots=2,
+                           n_requests=6, max_new=8):
+    """Multi-replica failover rows (serving/router.py + replica_server):
+    two in-process replicas behind the router, one hard-failed mid-burst.
+
+    - ``router_failover_extra_ttft_ms`` — added first-token latency of a
+      re-queued request (router-side TTFT) vs the undisturbed wave's
+      median: what one replica death costs the requests it interrupts
+      (re-queue backoff + full replay on the survivor).
+    - ``router_requeue_success_rate`` — re-queued requests that still
+      finished / re-queued requests. Asserted 1.0: the robustness
+      headline (kill any replica mid-burst, every request completes) is
+      a regression the `report --diff` sentry must catch, not a vibe.
+    """
+    import dataclasses
+    import threading
+
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving.engine import ServingEngine
+    from accelerate_tpu.serving.replica_server import ReplicaServer
+    from accelerate_tpu.serving.router import Router, RouterConfig
+
+    cap = -(-(prompt_len + max_new + page_size) // page_size) * page_size
+    cfg = dataclasses.replace(cfg, max_cache_len=min(cfg.max_seq_len, cap))
+    model_def = DecoderLM(cfg)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len
+    )
+    params, _ = unbox_params(variables["params"])
+    chunk = max(page_size, prompt_len // 2)
+
+    def mk(name):
+        engine = ServingEngine(
+            model_def, params, num_slots=num_slots,
+            max_cache_len=cfg.max_cache_len, prefill_chunks=(chunk,),
+            page_size=page_size, replica=name,
+        )
+        engine.telemetry = None
+        engine.warmup()
+        return engine
+
+    engines = {n: mk(n) for n in ("A", "B")}
+    for engine in engines.values():
+        # AFTER both warmups: the compile counters are process-global,
+        # so B's warmup must not read as recompiles on steady-marked A
+        engine.mark_steady()
+    servers = {
+        n: ReplicaServer(e, name=n).start() for n, e in engines.items()
+    }
+    router = Router(
+        {n: s.url for n, s in servers.items()},
+        config=RouterConfig(backoff_base_s=0.01, backoff_cap_s=0.05,
+                            max_retries=6, poll_interval_s=0.1,
+                            migrate_session_kv=False),
+    )
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_requests)]
+
+    def wave(kill: bool):
+        router.collector.poll_once()
+        results = [None] * n_requests
+        ttfts = [None] * n_requests
+        first_token = threading.Event()
+
+        def one(i):
+            t0 = time.perf_counter()
+
+            def on_tok(tok, req, _i=i, _t0=t0):
+                if ttfts[_i] is None:
+                    ttfts[_i] = time.perf_counter() - _t0
+                    first_token.set()
+
+            results[i] = router.submit(
+                [int(t) for t in prompts[i]], max_new_tokens=max_new,
+                seed=i, on_token=on_tok,
+            )
+
+        threads = [threading.Thread(target=one, args=(i,), daemon=True)
+                   for i in range(n_requests)]
+        for t in threads:
+            t.start()
+        victim = None
+        if kill:
+            assert first_token.wait(timeout=120), "burst never started"
+            # kill whichever replica the burst actually landed on (the
+            # router's least-loaded placement decides, not this bench)
+            victim = "A" if (
+                servers["A"].engine._slot_req or servers["A"].engine._pending()
+            ) else "B"
+            servers[victim].kill()
+        for t in threads:
+            t.join(timeout=300)
+        assert all(r is not None and r.done for r in results), (
+            "a routed request never reached a definite outcome"
+        )
+        return results, ttfts, victim
+
+    try:
+        clean, clean_ttfts, _ = wave(False)
+        assert all(r.outcome == "finished" for r in clean)
+        base_ms = 1e3 * float(np.median([t for t in clean_ttfts if t]))
+        killed, kill_ttfts, victim = wave(True)
+        requeued = [
+            (r, t) for r, t in zip(killed, kill_ttfts)
+            if any("error" in h for h in r.hops)
+        ]
+        survivor = servers["B" if victim == "A" else "A"].engine
+        out = {
+            "requests": n_requests,
+            "requeued": len(requeued),
+            "ttft_clean_ms": round(base_ms, 2),
+            # vacuously 1.0 when the kill interrupted nothing (all
+            # requests beat the kill on a fast machine): "no request was
+            # lost" still holds and the sentry must not spuriously trip
+            "router_requeue_success_rate": (
+                sum(r.outcome == "finished" for r, _ in requeued)
+                / len(requeued) if requeued else 1.0
+            ),
+            "survivor_recompiles": survivor.admission_recompiles,
+        }
+        if requeued:
+            rq_ms = 1e3 * float(np.median(
+                [t for _, t in requeued if t is not None]
+            ))
+            out["router_failover_extra_ttft_ms"] = round(rq_ms - base_ms, 2)
+        assert out["router_requeue_success_rate"] == 1.0, (
+            "a re-queued request failed to complete on the survivor"
+        )
+        assert all(r.outcome == "finished" for r in killed)
+        assert survivor.admission_recompiles == 0, (
+            "the survivor recompiled post-steady while absorbing re-queues"
+        )
+        return out
+    finally:
+        router.close()
+        for s in servers.values():
+            s.close()
+
+
 def _pipeline_mem_worker():
     """Compiled temp-memory (stash + belts) for gpipe-under-AD vs the manual
     1F1B schedule at M=4S, on the 8-device CPU sim (the schedule's win is a
@@ -1739,6 +1880,18 @@ def main():
         extra["serving_isolation_degradation_x"] = (
             extra["serving_isolation"]["storm_degradation_x"]
         )
+
+        # multi-replica failover: kill a replica mid-burst behind the
+        # router, publish the re-queue cost + asserted success rate
+        extra["router_failover"] = _router_failover_bench(
+            ttft_cfg, 128, page_size=64, num_slots=2,
+        )
+        extra["router_failover_extra_ttft_ms"] = (
+            extra["router_failover"].get("router_failover_extra_ttft_ms")
+        )
+        extra["router_requeue_success_rate"] = (
+            extra["router_failover"]["router_requeue_success_rate"]
+        )
         # the transfer_flush noise rows (median-of-rounds + spread; the
         # best-attempt phase breakdown above keeps the old shape)
         for v in ("bf16", "int8", "int4"):
@@ -1847,6 +2000,16 @@ def main():
         )
         extra["serving_isolation_degradation_x"] = (
             extra["serving_isolation"]["storm_degradation_x"]
+        )
+        extra["router_failover"] = _router_failover_bench(
+            DecoderConfig.tiny(max_seq_len=256), 32, page_size=16,
+            num_slots=2, n_requests=6, max_new=8,
+        )
+        extra["router_failover_extra_ttft_ms"] = (
+            extra["router_failover"].get("router_failover_extra_ttft_ms")
+        )
+        extra["router_requeue_success_rate"] = (
+            extra["router_failover"]["router_requeue_success_rate"]
         )
 
     # static-audit regression rows (both branches; post-warmup pass)

@@ -1,0 +1,611 @@
+"""Multi-replica router (accelerate_tpu/serving/router.py) — jax-free.
+
+The contracts of record:
+- placement is least-loaded off the PR 11 `placement_view()` contract,
+  with session affinity promoting the sticky replica while it stays
+  placeable;
+- the re-queue backoff schedule is a deterministic pure function of
+  (seed, request_id): capped exponential with seeded jitter;
+- a failed hop grows the per-request exclusion list and the request
+  still reaches a definite outcome (finished via a survivor, or shed
+  with a bounded-vocabulary reason — never a hang, never an exception);
+- mid-stream drops re-queue WITHOUT re-emitting the already-delivered
+  prefix (the client stream stays token-exact end to end);
+- bounded router queues shed with shed_reason=router_queue_full;
+- draining replicas take no new placements but stay visible through
+  placement_view(include_draining=True) — live streams are not
+  orphaned;
+- the seeded network fault injector (connection-refused, slow-replica,
+  mid-stream drop) replays the same schedule for the same seed.
+
+Everything here runs with no jax/flax and no real engine: replicas are
+scripted transports + scripted scrape snapshots.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from accelerate_tpu.serving.faults import FaultInjector, StreamDropped
+from accelerate_tpu.serving.router import (
+    SHED_NO_REPLICAS,
+    SHED_RETRIES_EXHAUSTED,
+    SHED_ROUTER_QUEUE_FULL,
+    Router,
+    RouterConfig,
+    RouterServer,
+    backoff_schedule,
+)
+from accelerate_tpu.telemetry.fleet import DRAINING, UNREACHABLE
+
+
+def _gauges(load=0.1, draining=False, **over):
+    g = {
+        "att_serving_queue_depth": 0,
+        "att_serving_num_slots": 4,
+        "att_serving_free_slots": 4,
+        "att_serving_slot_occupancy": 0.0,
+        "att_serving_load_score": load,
+    }
+    if draining:
+        g["att_serving_draining"] = 1
+        g["att_serving_load_score"] = load + 1e6
+    g.update(over)
+    return "\n".join(f"{k} {v}" for k, v in g.items()) + "\n"
+
+
+class ScriptedFleet:
+    """fetch_fn for the router's collector: per-replica exposition text
+    (or an exception to simulate a dead scrape endpoint)."""
+
+    def __init__(self):
+        self.replies = {}
+
+    def set(self, name, *, load=0.1, draining=False, dead=False):
+        key = f"http://{name}/metrics"
+        if dead:
+            self.replies[key] = OSError("connection refused")
+        else:
+            self.replies[key] = _gauges(load=load, draining=draining)
+
+    def __call__(self, target):
+        reply = self.replies[target]
+        if isinstance(reply, Exception):
+            raise reply
+        return reply
+
+
+class ScriptedTransport:
+    """Per-replica scripted stream behaviors, consumed in order. Each
+    behavior: dict(tokens=[...], outcome=..., drop_after=None,
+    refuse=False, shed_reason=None)."""
+
+    def __init__(self):
+        self.scripts = {}      # base_url -> list of behaviors
+        self.calls = []        # (base_url, payload)
+        self.posts = []        # (base_url, path, payload)
+        self.post_replies = {}
+
+    def script(self, name, **behavior):
+        self.scripts.setdefault(f"http://{name}", []).append(behavior)
+
+    def stream_submit(self, base_url, payload, *, on_event):
+        self.calls.append((base_url, payload))
+        queue = self.scripts.get(base_url) or []
+        b = queue.pop(0) if len(queue) > 1 else (queue[0] if queue else {})
+        if b.get("refuse"):
+            raise ConnectionRefusedError(f"scripted refusal from {base_url}")
+        tokens = b.get("tokens", [1, 2, 3])
+        for i, t in enumerate(tokens):
+            if b.get("drop_after") is not None and i >= b["drop_after"]:
+                raise StreamDropped(f"scripted drop from {base_url} at {i}")
+            on_event({"event": "token", "i": i, "token": t})
+        done = {
+            "event": "done", "outcome": b.get("outcome", "finished"),
+            "finish_reason": b.get("finish_reason", "budget"),
+            "shed_reason": b.get("shed_reason"), "tokens": tokens,
+            "prefix_hit": b.get("prefix_hit", 0),
+        }
+        on_event(done)
+        return done
+
+    def post_json(self, base_url, path, payload):
+        self.posts.append((base_url, path, payload))
+        reply = self.post_replies.get((base_url, path))
+        if isinstance(reply, Exception):
+            raise reply
+        return reply or {}
+
+
+def make_router(names=("A", "B"), *, config=None, faults=None):
+    fleet = ScriptedFleet()
+    transport = ScriptedTransport()
+    for n in names:
+        fleet.set(n)
+    router = Router(
+        {n: f"http://{n}" for n in names},
+        config=config or RouterConfig(backoff_base_s=0.001,
+                                      backoff_cap_s=0.01,
+                                      failure_cooldown_s=30.0),
+        transport=transport, fetch_fn=fleet, faults=faults,
+    )
+    router.collector.poll_once()
+    return router, fleet, transport
+
+
+class TestBackoffSchedule:
+    def test_deterministic_per_seed_and_request(self):
+        a = backoff_schedule(0, "req-1", 5)
+        assert a == backoff_schedule(0, "req-1", 5)
+        assert a != backoff_schedule(0, "req-2", 5)
+        assert a != backoff_schedule(1, "req-1", 5)
+
+    def test_capped_exponential_with_bounded_jitter(self):
+        sched = backoff_schedule(7, 42, 8, base_s=0.1, cap_s=1.0)
+        for i, delay in enumerate(sched):
+            hi = min(1.0, 0.1 * 2 ** i)
+            assert hi * 0.5 <= delay <= hi, (i, delay)
+        # the cap actually binds on the tail
+        assert max(sched) <= 1.0
+
+    def test_jitter_never_zero(self):
+        assert all(d > 0 for d in backoff_schedule(0, "x", 16, base_s=0.01))
+
+
+class TestPlacementAndAffinity:
+    def test_least_loaded_wins(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", load=2.0)
+        fleet.set("B", load=0.1)
+        router.collector.poll_once()
+        transport.script("B", tokens=[9, 9])
+        req = router.submit([1, 2, 3], max_new_tokens=2, seed=0)
+        assert req.outcome == "finished"
+        assert req.replica == "B"
+        assert [h["replica"] for h in req.hops] == ["B"]
+
+    def test_session_affinity_sticks_then_falls_back(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", load=0.1)
+        fleet.set("B", load=2.0)
+        router.collector.poll_once()
+        transport.script("A", tokens=[1])
+        transport.script("B", tokens=[1])
+        r1 = router.submit([1], max_new_tokens=1, seed=0, session="s")
+        assert r1.replica == "A"
+        # A becomes the worse choice — the session still sticks to it
+        fleet.set("A", load=5.0)
+        fleet.set("B", load=0.1)
+        router.collector.poll_once()
+        r2 = router.submit([1], max_new_tokens=1, seed=0, session="s")
+        assert r2.replica == "A"
+        # ...until A drains: the session falls back to least-loaded
+        fleet.set("A", draining=True)
+        router.collector.poll_once()
+        r3 = router.submit([1], max_new_tokens=1, seed=0, session="s")
+        assert r3.replica == "B"
+
+    def test_draining_visible_via_include_draining_only(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", draining=True)
+        router.collector.poll_once()
+        placeable = router.collector.placement_view()
+        assert [r["replica"] for r in placeable] == ["B"]
+        with_drain = router.collector.placement_view(include_draining=True)
+        assert [r["replica"] for r in with_drain] == ["B", "A"]
+        row = with_drain[-1]
+        assert row["state"] == DRAINING and not row["placeable"]
+        # the router's own view keeps the draining replica visible so
+        # live streams / KV exports can still be routed to it
+        assert "A" in {r["replica"] for r in router.placement()}
+
+    def test_deregistered_replica_leaves_placement(self):
+        router, fleet, transport = make_router()
+        assert len(router.collector.placement_view()) == 2
+        assert router.deregister_replica("A")
+        assert [r["replica"] for r in router.collector.placement_view()] == ["B"]
+        transport.script("B", tokens=[5])
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.replica == "B"
+
+    def test_registered_replica_joins_after_first_scrape(self):
+        router, fleet, transport = make_router(names=("A",))
+        fleet.set("C", load=0.05)
+        router.register_replica("C", "http://C")
+        router.collector.poll_once()
+        names = {r["replica"] for r in router.collector.placement_view()}
+        assert names == {"A", "C"}
+
+
+class TestFailoverAndRequeue:
+    def test_refused_connection_grows_exclusions_and_requeues(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", load=0.05)  # A ranks first...
+        router.collector.poll_once()
+        transport.script("A", refuse=True)
+        transport.script("B", tokens=[7, 8, 9])
+        req = router.submit([1, 2], max_new_tokens=3, seed=0)
+        assert req.outcome == "finished"
+        assert req.replica == "B"
+        assert [h["replica"] for h in req.hops] == ["A", "B"]
+        assert "error" in req.hops[0] and "error" not in req.hops[1]
+        assert router.requeues == 1
+        assert router.requeue_success == 1
+        assert router.replica_failures == {"A": 1}
+        # the failure excludes A immediately (before any health poll)
+        assert "A" in router._failed_now(time.time())
+
+    def test_mid_stream_drop_does_not_reemit_prefix(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", load=0.05)
+        router.collector.poll_once()
+        transport.script("A", tokens=[10, 11, 12, 13], drop_after=2)
+        transport.script("B", tokens=[10, 11, 12, 13])
+        seen = []
+        req = router.submit([1], max_new_tokens=4, seed=0,
+                            on_token=lambda t, r: seen.append(t))
+        assert req.outcome == "finished"
+        assert req.tokens == [10, 11, 12, 13]
+        assert seen == [10, 11, 12, 13]  # prefix delivered exactly once
+        assert [h["replica"] for h in req.hops] == ["A", "B"]
+        assert "StreamDropped" in req.hops[0]["error"]
+
+    def test_every_replica_failing_sheds_retries_exhausted(self):
+        router, fleet, transport = make_router(
+            config=RouterConfig(max_retries=2, backoff_base_s=0.001,
+                                backoff_cap_s=0.002)
+        )
+        transport.script("A", refuse=True)
+        transport.script("B", refuse=True)
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.outcome == "shed"
+        assert req.shed_reason == SHED_RETRIES_EXHAUSTED
+        assert req.done and req.finish_t is not None  # definite, not hung
+
+    def test_no_replicas_sheds(self):
+        router = Router(
+            {}, config=RouterConfig(backoff_base_s=0.001),
+            transport=ScriptedTransport(), fetch_fn=lambda t: "",
+        )
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.outcome == "shed"
+        assert req.shed_reason == SHED_NO_REPLICAS
+
+    def test_replica_shed_draining_tries_next(self):
+        """A replica that began draining between the scrape and the
+        connect answers `shed: draining` — the router treats that as
+        unplaceable, not failed, and places elsewhere."""
+        router, fleet, transport = make_router()
+        fleet.set("A", load=0.05)
+        router.collector.poll_once()
+        transport.script("A", outcome="shed", shed_reason="draining", tokens=[])
+        transport.script("B", tokens=[3])
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.outcome == "finished" and req.replica == "B"
+        assert router.replica_failures == {}  # drain is not a failure
+
+    def test_bounded_queue_sheds_router_queue_full(self):
+        router, fleet, transport = make_router(
+            config=RouterConfig(max_inflight=0)
+        )
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.outcome == "shed"
+        assert req.shed_reason == SHED_ROUTER_QUEUE_FULL
+        assert router.metrics()["router/requests_shed"] == 1
+
+    def test_request_timeout_is_cancelled_not_hung(self):
+        router, fleet, transport = make_router(
+            config=RouterConfig(max_retries=100, backoff_base_s=0.01,
+                                backoff_cap_s=0.02, request_timeout_s=0.05)
+        )
+        transport.script("A", refuse=True)
+        transport.script("B", refuse=True)
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.outcome == "cancelled"
+        assert req.finish_reason == "timeout"
+
+    def test_timeout_budget_is_forwarded_into_the_hop(self):
+        """The caller's wall must bind MID-stream too: the hop payload
+        carries the remaining budget so the replica's own timeout path
+        cancels a healthy-but-slow stream."""
+        router, fleet, transport = make_router(
+            config=RouterConfig(request_timeout_s=5.0)
+        )
+        transport.script("A", tokens=[1])
+        transport.script("B", tokens=[1])
+        router.submit([1], max_new_tokens=1, seed=0)
+        payload = transport.calls[-1][1]
+        assert 0 < payload["timeout_s"] <= 5.0
+        # no budget -> no replica-side timeout imposed
+        router2, _, transport2 = make_router()
+        transport2.script("A", tokens=[1])
+        transport2.script("B", tokens=[1])
+        router2.submit([1], max_new_tokens=1, seed=0)
+        assert "timeout_s" not in transport2.calls[-1][1]
+
+    def test_exclusions_reset_after_health_refresh(self):
+        """A transient failure must not permanently exclude the only
+        replica for the request's lifetime: once candidates run dry the
+        router refreshes health and drops the per-request exclusions, so
+        a recovered replica is retried (genuinely-bad ones stay out via
+        the health state / failure cooldown)."""
+        calls = []
+
+        class OneRefusalTransport(ScriptedTransport):
+            def stream_submit(self, base_url, payload, *, on_event):
+                calls.append(base_url)
+                if len(calls) == 1:
+                    raise ConnectionRefusedError("transient blip")
+                return super().stream_submit(base_url, payload,
+                                             on_event=on_event)
+
+        fleet = ScriptedFleet()
+        fleet.set("A")
+        transport = OneRefusalTransport()
+        transport.script("A", tokens=[4])
+        router = Router(
+            {"A": "http://A"},
+            config=RouterConfig(backoff_base_s=0.001, backoff_cap_s=0.002,
+                                max_retries=4, failure_cooldown_s=0.0),
+            transport=transport, fetch_fn=fleet,
+        )
+        router.collector.poll_once()
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.outcome == "finished"
+        assert calls == ["http://A", "http://A"]  # same replica, retried
+        assert router.requests_requeued == 1
+        assert router.requeue_success == 1
+
+    def test_requeue_accounting_hops_vs_requests(self):
+        """requeues counts failed HOPS; requests_requeued and
+        requeue_success count REQUESTS — one request failing on two
+        replicas before landing on a third is 2 / 1 / 1 (the runbook's
+        comparison is requests_requeued == requeue_success)."""
+        router, fleet, transport = make_router(names=("A", "B", "C"))
+        fleet.set("C")
+        fleet.set("A", load=0.01)
+        fleet.set("B", load=0.02)
+        router.collector.poll_once()
+        transport.script("A", refuse=True)
+        transport.script("B", refuse=True)
+        transport.script("C", tokens=[1])
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.outcome == "finished" and req.replica == "C"
+        m = router.metrics()
+        assert m["router/requeues"] == 2
+        assert m["router/requests_requeued"] == 1
+        assert m["router/requeue_success"] == 1
+
+    def test_stitchable_request_id_rides_every_hop(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", load=0.05)
+        router.collector.poll_once()
+        transport.script("A", refuse=True)
+        transport.script("B", tokens=[1])
+        req = router.submit([1], max_new_tokens=1, seed=3,
+                            request_id="ext-42")
+        assert req.id == "ext-42"
+        payloads = [p for _, p in transport.calls]
+        assert all(p["request_id"] == "ext-42" for p in payloads)
+        assert all(p["seed"] == 3 for p in payloads)  # replay = same chain
+
+
+class TestNetworkFaultInjection:
+    def test_seeded_refusal_schedule_replays(self):
+        def run(seed):
+            faults = FaultInjector(seed=seed).refuse_connect(prob=0.5,
+                                                             count=None)
+            fired = []
+            for i in range(32):
+                try:
+                    faults.before_connect("A")
+                except ConnectionRefusedError:
+                    fired.append(i)
+            return fired
+
+        assert run(0) == run(0)
+        assert run(0) != run(1)
+
+    def test_drop_stream_and_slow_replica_fire_and_log(self):
+        sleeps = []
+        faults = (
+            FaultInjector(seed=0, sleep_fn=sleeps.append)
+            .slow_replica(replica="A", delay_s=0.5, count=1)
+            .drop_stream(replica="A", after_tokens=2, count=1)
+        )
+        faults.before_connect("A")
+        assert sleeps == [0.5]
+        faults.before_connect("A")  # count=1: fires once
+        assert sleeps == [0.5]
+        faults.on_stream_event("A", 0)
+        faults.on_stream_event("B", 5)  # other replica: untouched
+        with pytest.raises(StreamDropped):
+            faults.on_stream_event("A", 2)
+        kinds = [k for _, k, _ in faults.log]
+        assert kinds == ["slow_replica", "drop_stream"]
+
+    def test_injected_refusal_drives_router_failover(self):
+        faults = FaultInjector(seed=0).refuse_connect(replica="A", count=1)
+        router, fleet, transport = make_router(faults=faults)
+        fleet.set("A", load=0.05)
+        router.collector.poll_once()
+        transport.script("A", tokens=[1, 2])
+        transport.script("B", tokens=[1, 2])
+        req = router.submit([1], max_new_tokens=2, seed=0)
+        assert req.outcome == "finished" and req.replica == "B"
+        assert "ConnectionRefusedError" in req.hops[0]["error"]
+
+
+class TestKvMigration:
+    def test_sticky_session_moving_off_draining_replica_migrates(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", load=0.05)
+        router.collector.poll_once()
+        transport.script("A", tokens=[1])
+        transport.script("B", tokens=[1])
+        r1 = router.submit([5, 6, 7], max_new_tokens=1, seed=0, session="s")
+        assert r1.replica == "A"
+        fleet.set("A", draining=True)
+        router.collector.poll_once()
+        transport.post_replies[("http://A", "/v1/kv/export")] = {
+            "version": 1, "n_pages": 1, "token_len": 2, "tokens": [5, 6],
+            "page_size": 2, "leaves": [],
+        }
+        transport.post_replies[("http://B", "/v1/kv/import")] = {
+            "installed_tokens": 2,
+        }
+        r2 = router.submit([5, 6, 7], max_new_tokens=1, seed=0, session="s")
+        assert r2.replica == "B"
+        assert router.kv_migrations == 1
+        assert ("http://A", "/v1/kv/export", {"tokens": [5, 6, 7]}) in transport.posts
+        hop_kinds = [h for h in r2.hops if "kv_migrated_from" in h]
+        assert hop_kinds and hop_kinds[0]["kv_migrated_from"] == "A"
+
+    def test_migration_failure_is_absorbed(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", load=0.05)
+        router.collector.poll_once()
+        transport.script("A", tokens=[1])
+        transport.script("B", tokens=[1])
+        r1 = router.submit([5, 6], max_new_tokens=1, seed=0, session="s")
+        assert r1.replica == "A"
+        fleet.set("A", dead=True)
+        router.collector.poll_once()
+        transport.post_replies[("http://A", "/v1/kv/export")] = OSError("gone")
+        r2 = router.submit([5, 6], max_new_tokens=1, seed=0, session="s")
+        assert r2.outcome == "finished" and r2.replica == "B"
+        assert router.kv_migrations == 0
+
+
+class TestRouterServerHttp:
+    """The stdlib front door end to end against a fake JSONL replica —
+    no jax, real sockets."""
+
+    def _fake_replica(self, tokens):
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = _gauges(load=0.1).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(n))
+                self.send_response(200)
+                self.end_headers()
+                for i, t in enumerate(tokens):
+                    self.wfile.write((json.dumps(
+                        {"event": "token", "i": i, "token": t}
+                    ) + "\n").encode())
+                self.wfile.write((json.dumps({
+                    "event": "done", "outcome": "finished",
+                    "finish_reason": "budget", "tokens": tokens,
+                    "request_id": payload.get("request_id"),
+                }) + "\n").encode())
+
+            def log_message(self, *args):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd
+
+    def test_submit_register_placement_metrics_round_trip(self):
+        replica = self._fake_replica([4, 5, 6])
+        router = Router({}, config=RouterConfig(poll_interval_s=0.05))
+        server = RouterServer(router, port=0)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            # elastic join over HTTP
+            req = urllib.request.Request(
+                f"{base}/v1/register",
+                data=json.dumps({
+                    "name": "r0",
+                    "url": f"http://127.0.0.1:{replica.server_address[1]}",
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert json.loads(resp.read())["ok"]
+            router.collector.poll_once()
+            with urllib.request.urlopen(f"{base}/v1/placement", timeout=5) as resp:
+                view = json.loads(resp.read())["placement"]
+            assert [r["replica"] for r in view] == ["r0"]
+            # streamed submit through the front door
+            req = urllib.request.Request(
+                f"{base}/v1/submit",
+                data=json.dumps({"prompt": [1, 2], "max_new_tokens": 3,
+                                 "seed": 0}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                lines = [json.loads(l) for l in resp.read().splitlines() if l]
+            assert [e["token"] for e in lines if e["event"] == "token"] == [4, 5, 6]
+            done = lines[-1]
+            assert done["event"] == "done" and done["outcome"] == "finished"
+            assert done["replica"] == "r0" and done["requeues"] == 0
+            with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
+                text = resp.read().decode()
+            assert "att_router_requests_completed 1" in text
+        finally:
+            server.close()
+            router.close()
+            replica.shutdown()
+            replica.server_close()
+
+    def test_jax_free(self):
+        import sys
+
+        assert "jax" not in sys.modules or True  # in-suite guard is weak;
+        # the real lock is the hygiene-derived subprocess probe in
+        # test_imports.py (serving.router is in JAX_FREE_MODULES)
+
+
+class TestServeCommandRegistration:
+    def test_serve_registers_and_parses_jax_free(self):
+        """The `serve` subcommand registers lazily (PR 12 pattern) and
+        its router role parses without any heavy import — the hygiene-
+        derived subprocess probe in test_imports locks the import side;
+        this locks the argparse surface."""
+        import argparse
+
+        from accelerate_tpu.commands import serve
+
+        parser = argparse.ArgumentParser()
+        sub = parser.add_subparsers()
+        serve.register(sub)
+        args = parser.parse_args([
+            "serve", "router", "--replica", "A=http://a:1",
+            "--replica", "http://b:2", "--max-inflight", "8",
+        ])
+        assert args.func is serve.serve_command
+        assert serve._parse_replica_flags(args.replica) == [
+            ("A", "http://a:1"), ("r1", "http://b:2"),
+        ]
+        args = parser.parse_args(["serve", "replica", "--page-size", "8"])
+        assert args.page_size == 8
+
+    def test_bare_serve_prints_usage(self, capsys):
+        import argparse
+
+        from accelerate_tpu.commands.serve import serve_command
+
+        assert serve_command(argparse.Namespace(role=None)) == 1
+        assert "router|replica" in capsys.readouterr().out
+
+
+class TestRouterHealthIntegration:
+    def test_failed_replica_unreachable_within_one_poll(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", dead=True)
+        router.collector.poll_once()
+        assert router.collector.replicas["A"].state == UNREACHABLE
+        assert [r["replica"] for r in router.collector.placement_view()] == ["B"]
