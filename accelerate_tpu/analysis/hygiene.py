@@ -48,6 +48,8 @@ JAX_FREE_MODULES = (
     "accelerate_tpu.telemetry.alerts",
     "accelerate_tpu.telemetry.usage",
     "accelerate_tpu.telemetry.fleet",
+    "accelerate_tpu.telemetry.canary",
+    "accelerate_tpu.telemetry.waterfall",
     "accelerate_tpu.serving.pages",
     "accelerate_tpu.serving.scheduler",
     "accelerate_tpu.serving.faults",

@@ -12,6 +12,8 @@ time go and why". It merges everything a session leaves behind —
     timeline-host<i>.jsonl   continuous gauge timeline (sampled rollups)
     alerts-host<i>.jsonl     alert lifecycle events (pending/firing/resolved)
     usage-host<i>.json       per-tenant usage accounting
+    router-requests*.jsonl   router request log (waterfall's router half)
+    canary-results.jsonl     synthetic canary probe outcomes
     audit.json               static-audit findings (`accelerate-tpu audit --out`)
 
 — into one explanation:
@@ -270,6 +272,49 @@ def load_fleet_summary(target: str) -> dict:
     return load_fleet(target)
 
 
+def load_waterfall_summary(target: str) -> dict:
+    """Per-stage TTFT decomposition aggregate — present when a
+    ``Router(log_dir=...)`` left ``router-requests*.jsonl`` here
+    (replica request logs join in when they share the dir)."""
+    if not _host_files(target, "router-requests*.jsonl"):
+        return {}
+    from ..telemetry.waterfall import summarize_waterfall
+    from .trace import build_waterfall_rows
+
+    rows = build_waterfall_rows(target)
+    return summarize_waterfall(rows) if rows else {}
+
+
+def load_canary_summary(target: str) -> dict:
+    """Canary probe outcomes out of ``canary-results.jsonl``: totals,
+    recent pass ratio, and the replicas that served failing probes."""
+    if not _host_files(target, "canary-results.jsonl"):
+        return {}
+    from ..telemetry.canary import load_canary
+
+    results = load_canary(target)
+    if not results:
+        return {}
+    failed = [r for r in results if not r.get("passed")]
+    by_replica: dict = {}
+    for r in failed:
+        # a probe that never reached a replica (router down, submit_fn
+        # error) has no attribution — say so, don't render "None"
+        name = r.get("replica") or "(unattributed)"
+        by_replica[str(name)] = by_replica.get(str(name), 0) + 1
+    recent = results[-32:]
+    return {
+        "probes": len(results),
+        "passed": sum(1 for r in results if r.get("passed")),
+        "failed": len(failed),
+        "pass_ratio": round(
+            sum(1 for r in recent if r.get("passed")) / len(recent), 4
+        ),
+        "failing_replicas": by_replica,
+        "last_failure": failed[-1] if failed else None,
+    }
+
+
 def load_audit(target: str) -> dict:
     """The static-audit snapshot (``audit.json`` written by
     ``accelerate-tpu audit --out DIR``): active findings, baselined
@@ -295,6 +340,8 @@ def load_report(target: str) -> dict:
         "alerts": load_alert_summary(target),
         "usage": load_usage_table(target),
         "fleet": load_fleet_summary(target),
+        "waterfall": load_waterfall_summary(target),
+        "canary": load_canary_summary(target),
         "audit": load_audit(target),
     }
     req_files = _host_files(target, "requests-host*.jsonl")
@@ -481,6 +528,38 @@ def format_report(data: dict) -> str:
                     f"{evt.get('from')} -> {evt.get('to')} ({evt.get('reason')})"
                 )
 
+    wf = data.get("waterfall") or {}
+    if wf.get("requests"):
+        from ..telemetry.waterfall import stage_table
+
+        lines.append("")
+        lines.append(
+            f"request waterfall ({wf['requests']} request(s), "
+            f"{wf.get('joined', 0)} joined with replica records"
+            + (f"; e2e TTFT p50/p99 = {wf['e2e_ttft_p50_ms']}/"
+               f"{wf['e2e_ttft_p99_ms']} ms"
+               if wf.get("e2e_ttft_p99_ms") is not None else "")
+            + "):"
+        )
+        lines.extend(render_table(stage_table(wf)))
+
+    canary = data.get("canary") or {}
+    if canary.get("probes"):
+        lines.append("")
+        lines.append(
+            f"canary: {canary['probes']} probe(s), {canary['failed']} "
+            f"failed, recent pass ratio {canary['pass_ratio']}"
+        )
+        for name, n in sorted((canary.get("failing_replicas") or {}).items(),
+                              key=lambda kv: -kv[1]):
+            lines.append(f"  failing probes served by {name}: {n}")
+        last = canary.get("last_failure")
+        if last:
+            lines.append(
+                f"  last failure: {last.get('request_id')} on "
+                f"{last.get('replica')} ({last.get('reason', '?')})"
+            )
+
     usage = data.get("usage") or {}
     tenants = usage.get("tenants") or {}
     if tenants:
@@ -594,6 +673,19 @@ def collect_diff_metrics(target: str) -> dict:
             out[f"timeline/{key}/mean"] = float(s["mean"])
     for tenant, row in ((data.get("usage") or {}).get("tenants") or {}).items():
         _flatten_numeric(row, f"usage/{tenant}", out)
+    # the edge regression signals: per-stage waterfall percentiles (a p99
+    # that moved names its stage) and the canary pass ratio (any drop is
+    # a correctness regression — diff_metrics flags it past-threshold-or-not)
+    wf = data.get("waterfall") or {}
+    for stage, row in (wf.get("stages") or {}).items():
+        for field in ("p50_ms", "p99_ms"):
+            if isinstance(row.get(field), (int, float)):
+                out[f"waterfall/{stage}/{field}"] = float(row[field])
+    if isinstance(wf.get("e2e_ttft_p99_ms"), (int, float)):
+        out["router_e2e_ttft_p99_ms"] = float(wf["e2e_ttft_p99_ms"])
+    canary = data.get("canary") or {}
+    if isinstance(canary.get("pass_ratio"), (int, float)):
+        out["canary_pass_ratio"] = float(canary["pass_ratio"])
     out["recompiles_diagnosed"] = float(len(data.get("recompiles") or []))
     audit = data.get("audit") or {}
     if audit:
@@ -610,10 +702,22 @@ def collect_diff_metrics(target: str) -> dict:
     return out
 
 
+# metrics where ANY drop — not just a past-threshold move — is a
+# regression: a canary pass ratio below its baseline means the service
+# returned wrong tokens, and correctness has no noise budget
+_DROP_SENTINEL_MARKERS = ("canary_pass_ratio", "canary/pass_ratio")
+
+
+def _is_sentinel_drop(key: str, va: float, vb: float,
+                      min_abs: float) -> bool:
+    return any(m in key for m in _DROP_SENTINEL_MARKERS) and vb < va - min_abs
+
+
 def diff_metrics(a: dict, b: dict, threshold: float = 0.1,
                  min_abs: float = 1e-9) -> dict:
     """Shared-metric comparison: relative change per metric, the ones
-    past ``threshold`` flagged (sorted, biggest mover first)."""
+    past ``threshold`` flagged (sorted, biggest mover first). Sentinel
+    metrics (canary pass ratio) flag on any decrease."""
     shared = sorted(set(a) & set(b))
     rows = []
     for key in shared:
@@ -629,17 +733,20 @@ def diff_metrics(a: dict, b: dict, threshold: float = 0.1,
             rel = (vb - va) / abs(va)
         rows.append({"metric": key, "a": va, "b": vb,
                      "rel_change": round(rel, 4) if rel is not None else None,
-                     "from_zero": rel is None})
+                     "from_zero": rel is None,
+                     "sentinel": _is_sentinel_drop(key, va, vb, min_abs)})
     # a P1 audit finding that exists only in B is NEW regression evidence
     # even though unshared keys normally stay out of the flag list (the
     # count metrics can stay level when one P1 is fixed and another lands)
     for key in sorted(set(b) - set(a)):
         if key.startswith("audit/p1/"):
             rows.append({"metric": key, "a": 0.0, "b": b[key],
-                         "rel_change": None, "from_zero": True})
+                         "rel_change": None, "from_zero": True,
+                         "sentinel": False})
     flagged = [r for r in rows
-               if r["from_zero"] or abs(r["rel_change"]) > threshold]
-    flagged.sort(key=lambda r: -(float("inf") if r["from_zero"]
+               if r["from_zero"] or r["sentinel"]
+               or abs(r["rel_change"]) > threshold]
+    flagged.sort(key=lambda r: -(float("inf") if (r["from_zero"] or r["sentinel"])
                                  else abs(r["rel_change"])))
     return {
         "shared_metrics": len(shared),
@@ -661,9 +768,11 @@ def format_diff(diff: dict, a_name: str, b_name: str) -> str:
         table = [("metric", "A", "B", "change")]
         for r in diff["flagged"][:40]:
             rel = r["rel_change"]
+            change = "from zero" if r["from_zero"] else f"{100 * rel:+.1f}%"
+            if r.get("sentinel"):
+                change += " (correctness sentinel)"
             table.append((
-                r["metric"], f"{r['a']:.4g}", f"{r['b']:.4g}",
-                "from zero" if r["from_zero"] else f"{100 * rel:+.1f}%",
+                r["metric"], f"{r['a']:.4g}", f"{r['b']:.4g}", change,
             ))
         lines.extend(render_table(table))
     else:
@@ -699,7 +808,8 @@ def report_command(args) -> int:
     if not (data["goodput"] or data["costs"].get("executables")
             or data["recompiles"] or data["first_compiles"] or data["steps"]
             or data["timeline"] or data["usage"] or data["alerts"]
-            or data["fleet"] or data["audit"]):
+            or data["fleet"] or data["waterfall"] or data["canary"]
+            or data["audit"]):
         print(f"no telemetry artifacts found under {args.target} — expected "
               "goodput-host*.json / costs-host*.json / forensics-host*.jsonl "
               "/ fleet.json / audit.json (see docs/telemetry.md)", file=sys.stderr)

@@ -58,6 +58,28 @@ def register(subparsers):
     router.add_argument("--no-kv-migration", action="store_true",
                         help="disable the KV handoff when a session moves "
                              "off a draining replica")
+    router.add_argument("--log-dir", default=None, metavar="DIR",
+                        help="write router-requests.jsonl (the latency "
+                             "waterfall's router half), "
+                             "router-decisions.jsonl (placement-decision "
+                             "log) and canary-results.jsonl here")
+    router.add_argument("--no-instrument", action="store_true",
+                        help="disable golden-signal histograms, hop "
+                             "timing stamps and the decision log (the "
+                             "zero-overhead witness baseline)")
+    router.add_argument("--canary-interval", type=float, default=0.0,
+                        metavar="S",
+                        help="probe the fleet with a seeded golden prompt "
+                             "every S seconds, verifying token-exactness "
+                             "(0 = off); gauges land on /metrics as "
+                             "canary/*")
+    router.add_argument("--canary-prompt", default="1,2,3",
+                        help="comma-separated golden prompt token ids "
+                             "(the first finished probe records the "
+                             "golden output every later probe must "
+                             "reproduce)")
+    router.add_argument("--canary-max-new-tokens", type=int, default=8)
+    router.add_argument("--canary-seed", type=int, default=0)
 
     replica = sub.add_parser(
         "replica", help="one engine process behind HTTP (demo model; "
@@ -128,11 +150,28 @@ def _serve_router(args) -> int:
         poll_interval_s=args.poll_interval,
         affinity=not args.no_affinity,
         migrate_session_kv=not args.no_kv_migration,
+        instrument=not args.no_instrument,
+        log_dir=args.log_dir,
     )
     router = Router(_parse_replica_flags(args.replica), config=cfg).start()
+    if args.canary_interval and args.canary_interval > 0:
+        from ..telemetry.canary import CanaryProber, flight_via_router, via_router
+
+        prompt = [int(t) for t in str(args.canary_prompt).split(",") if t.strip()]
+        prober = CanaryProber(
+            via_router(router),
+            [{"prompt": prompt, "seed": int(args.canary_seed),
+              "max_new_tokens": int(args.canary_max_new_tokens)}],
+            interval_s=float(args.canary_interval),
+            log_dir=args.log_dir,
+            flight_fn=flight_via_router(router),
+        ).start()
+        router.attach_canary(prober)
     server = RouterServer(router, host=args.host, port=args.port)
     print(json.dumps({"role": "router", "port": server.port,
-                      "replicas": len(args.replica)}), flush=True)
+                      "replicas": len(args.replica),
+                      "canary": bool(args.canary_interval),
+                      "log_dir": args.log_dir}), flush=True)
     try:
         import time
 

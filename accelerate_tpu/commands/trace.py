@@ -8,6 +8,7 @@ answers without a notebook:
     accelerate-tpu trace merge runs/exp/telemetry --request-id 42
     accelerate-tpu trace summary runs/exp/telemetry
     accelerate-tpu trace summary runs/exp/telemetry --request-id 42 --json
+    accelerate-tpu trace summary runs/exp/telemetry --waterfall
 
 ``merge`` folds every host's span stream into ONE Perfetto-loadable
 Chrome trace (hosts stay separate rows via their pid; per-host clock
@@ -17,8 +18,14 @@ writes), optionally filtered to the spans of a single request.
 per request plus aggregate TTFT/ITL/queue-wait percentiles from the same
 log-bucketed histograms the live session uses — or, with
 ``--request-id``, the full lifecycle of one request (prefill chunk plan,
-ITL series, compile activity). Pure stdlib + the telemetry host modules:
-no jax import, so it runs anywhere the log files land.
+ITL series, compile activity). ``summary --waterfall`` joins the
+router's own request log (``router-requests*.jsonl``) with the replica
+request logs and decomposes each request's client-observed TTFT into
+router-queue → placement → retry-backoff → transport → replica-queue →
+prefill stages that sum to the total (``telemetry/waterfall.py``;
+docs/serving.md "Reading the request waterfall"). Pure stdlib + the
+telemetry host modules: no jax import, so it runs anywhere the log
+files land.
 """
 
 from __future__ import annotations
@@ -242,6 +249,89 @@ def _format_stitched(stitched: dict) -> str:
     return "\n".join(lines)
 
 
+def build_waterfall_rows(target, router_records=None) -> list:
+    """Join a telemetry dir's router request log with its replica
+    request logs and decompose — the shared load half of
+    ``summary --waterfall`` and ``report``'s waterfall section."""
+    from ..telemetry.waterfall import build_waterfalls, load_router_requests
+
+    if router_records is None:
+        router_records = load_router_requests(target)
+    if not router_records:
+        return []
+    replica_recs = load_requests(target) if _request_files(target) else []
+    return build_waterfalls(router_records, replica_recs)
+
+
+def _format_waterfall(rows: list, agg: dict) -> str:
+    """The waterfall table: one row per request (stage columns in causal
+    order), then the per-stage percentile aggregate — the 'which stage
+    ate the p99' answer."""
+    from ..telemetry.waterfall import STAGES, stage_table
+
+    from .report import render_table  # the one shared table renderer
+
+    table = [("id", "replica", "hops", "e2e_ttft_ms")
+             + tuple(f"{s}_ms" for s in STAGES) + ("top",)]
+    for row in rows:
+        table.append((
+            str(row.get("request_id")), str(row.get("replica")),
+            str(1 + (row.get("requeues") or 0)),
+            str(row.get("e2e_ttft_ms")),
+        ) + tuple(str(row["stages"].get(s, "")) for s in STAGES)
+          + (row.get("top_stage", ""),))
+    lines = [
+        f"{agg.get('requests', 0)} request(s) decomposed "
+        f"({agg.get('joined', 0)} joined with replica-side records); "
+        "stages sum to the client-observed TTFT"
+    ]
+    lines.extend(render_table(table, indent=""))
+    st_table = stage_table(agg, include_mean=True)
+    if len(st_table) > 1:
+        lines.append("")
+        lines.append("per-stage aggregate (where the fleet's TTFT goes):")
+        lines.extend(render_table(st_table))
+    if agg.get("top_stages"):
+        lines.append("top stage by request: " + ", ".join(
+            f"{s}={n}" for s, n in sorted(
+                agg["top_stages"].items(), key=lambda kv: -kv[1]
+            )
+        ))
+    return "\n".join(lines)
+
+
+def _waterfall_summary(args) -> int:
+    from ..telemetry.waterfall import load_router_requests, summarize_waterfall
+
+    router_recs = load_router_requests(args.target)
+    if not router_recs:
+        print(
+            f"no router-requests*.jsonl found under {args.target} — run the "
+            "router with RouterConfig(log_dir=...) / `serve router "
+            "--log-dir` to record the waterfall's router-side half",
+            file=sys.stderr,
+        )
+        return 1
+    if args.request_id is not None:
+        router_recs = [r for r in router_recs
+                       if _same_id(r.get("request_id"), args.request_id)]
+        if not router_recs:
+            print(f"request id {args.request_id} not in the router log",
+                  file=sys.stderr)
+            return 1
+    rows = build_waterfall_rows(args.target, router_records=router_recs)
+    if not rows:
+        print("no request in the router log reached a first token — "
+              "nothing to decompose", file=sys.stderr)
+        return 1
+    agg = summarize_waterfall(rows)
+    if args.json:
+        print(json.dumps({"waterfalls": rows, "aggregate": agg}))
+    else:
+        print(_format_waterfall(rows, agg))
+    return 0
+
+
 def _format_table(records: list, agg: dict) -> str:
     cols = ("id", "host", "slot", "prompt", "tokens", "queue_ms", "ttft_ms",
             "itl_p50_ms", "total_ms", "reason")
@@ -293,6 +383,8 @@ def trace_command(args) -> int:
             print(body)
         return 0
     if args.trace_cmd == "summary":
+        if getattr(args, "waterfall", False):
+            return _waterfall_summary(args)
         records = load_requests(args.target)
         if not records:
             print(f"no request records found under {args.target}", file=sys.stderr)
@@ -349,6 +441,14 @@ def register(subparsers):
         help="print one request's full lifecycle record; with records "
              "from several replicas, stitch them into the hop-by-hop "
              "timeline",
+    )
+    summary.add_argument(
+        "--waterfall", action="store_true",
+        help="decompose each request's client-observed TTFT into stages "
+             "(router-queue / placement / retry-backoff / transport / "
+             "replica-queue / prefill) by joining router-requests*.jsonl "
+             "with the replica request logs; prints per-stage "
+             "p50/p95/p99 aggregates",
     )
     summary.add_argument("--json", action="store_true", help="machine-readable output")
     parser.set_defaults(func=trace_command)

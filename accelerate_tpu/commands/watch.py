@@ -27,6 +27,9 @@ client-side and renders the ranked replica health/placement table (the
 same ``placement_view()`` the router consumes), fleet-aggregate
 sparklines (counters summed, latency quantiles exactly merged from the
 native histograms), firing fleet alerts, and recent health transitions.
+When a router's ``/metrics`` is among the targets, a **router section**
+adds inflight/queue-depth sparklines, the shed-reason breakdown, and
+the synthetic-canary pass/fail status line.
 """
 
 from __future__ import annotations
@@ -305,6 +308,7 @@ def render_fleet_frame(collector, series_keys, width: int = 32,
                 f"{sparkline(hist, width)}  "
                 f"[{_fmt_num(min(hist))} .. {_fmt_num(max(hist))}]"
             )
+    lines.extend(_router_section(collector, width=width, span_s=span_s))
     states = collector.alerts.states_snapshot()
     firing = sorted(n for n, st in states.items() if st["state"] == "firing")
     lines.append("")
@@ -325,6 +329,71 @@ def render_fleet_frame(collector, series_keys, width: int = 32,
                 f"({evt['reason']})"
             )
     return "\n".join(lines)
+
+
+ROUTER_SERIES = ("router/inflight", "serving/queue_depth")
+
+
+def _router_section(collector, width: int = 32, span_s: float = 600.0) -> list:
+    """The router block of a fleet frame — present only when the fleet
+    actually exports ``router/*`` gauges (i.e. a router's ``/metrics``
+    is among the scrape targets): inflight/queue-depth sparklines, the
+    shed-reason breakdown, and the canary status line."""
+    gauges = collector.fleet_gauges()
+    router_keys = {k: v for k, v in gauges.items() if k.startswith("router/")}
+    if not router_keys:
+        return []
+    tl = collector.timeline
+    now = tl.last_t
+    lines = ["", (
+        "  router: "
+        f"inflight {_fmt_num(gauges.get('router/inflight'))}"
+        f" · submitted {_fmt_num(gauges.get('router/requests_submitted'))}"
+        f" · completed {_fmt_num(gauges.get('router/requests_completed'))}"
+        f" · requeues {_fmt_num(gauges.get('router/requeues'))}"
+        + (f" · ttft p99 {_fmt_num(gauges.get('router/ttft_p99_ms'))}ms"
+           if gauges.get("router/ttft_p99_ms") is not None else "")
+    )]
+    if now is not None:
+        for key in ROUTER_SERIES:
+            pts = tl.series(key, span_s, now=now)
+            if not pts:
+                continue
+            hist = [v for _, v in pts]
+            lines.append(
+                f"  {key:<32} {_fmt_num(hist[-1]):>10}  "
+                f"{sparkline(hist, width)}  "
+                f"[{_fmt_num(min(hist))} .. {_fmt_num(max(hist))}]"
+            )
+    # shed-reason breakdown (both key spellings: raw rollup router/shed/x
+    # and exposition-unflattened router/shed_x)
+    sheds = {}
+    for key, v in router_keys.items():
+        if key.startswith("router/shed") and key != "router/shed":
+            reason = key[len("router/shed"):].lstrip("/_")
+            if reason and v:
+                sheds[reason] = v
+    if sheds:
+        lines.append("  shed reasons: " + ", ".join(
+            f"{r}={_fmt_num(v)}" for r, v in
+            sorted(sheds.items(), key=lambda kv: -kv[1])
+        ))
+    sent = gauges.get("canary/probes_sent")
+    if sent:
+        ratio = gauges.get("canary/pass_ratio")
+        ok = ratio is not None and ratio >= 1.0
+        age = None
+        last_pass = gauges.get("canary/last_pass_unix_s")
+        if isinstance(last_pass, (int, float)) and last_pass > 0:
+            age = max(0.0, time.time() - last_pass)
+        lines.append(
+            f"  canary: {'OK' if ok else 'FAILING'}"
+            f" · pass ratio {_fmt_num(ratio)}"
+            f" · {_fmt_num(sent)} probes"
+            f" · {_fmt_num(gauges.get('canary/probes_failed'))} failed"
+            + (f" · last pass {age:.0f}s ago" if age is not None else "")
+        )
+    return lines
 
 
 def watch_fleet_command(args) -> int:

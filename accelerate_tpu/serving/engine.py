@@ -1382,6 +1382,15 @@ class ServingEngine:
             except Exception:
                 pass
 
+    def flight_dump(self, reason: str) -> bool:
+        """Capture a flight-recorder debug bundle now (the public face of
+        the internal hook — ``POST /v1/flight`` on a ReplicaServer and
+        the canary's failing-probe action both land here). Returns
+        whether a flight recorder exists to dump to."""
+        has_flight = getattr(self.telemetry, "flight", None) is not None
+        self._flight_dump(str(reason))
+        return has_flight
+
     def _plan_chunks(self, prompt_len: int):
         """(start, bucket) list covering [0, prompt_len) from the fixed
         bucket set — largest bucket that fits, smallest (padded) for the

@@ -22,7 +22,11 @@ makes "things go wrong" reproducible:
   mid-stream drops injected at the *router's* transport layer
   (``serving/router.py`` consults ``before_connect`` /
   ``on_stream_event``): the same injector that drove the single-engine
-  scheduler drills drives the multi-replica failover and kill drills.
+  scheduler drills drives the multi-replica failover and kill drills;
+- **wrong tokens** — silent content corruption injected at the *replica
+  server's* emit path (``ReplicaServer(faults=...)`` consults
+  ``corrupt_token``): valid framing, wrong answer — the failure class
+  only the synthetic canary (``telemetry/canary.py``) catches.
 
 Everything is **seeded and scripted**: probabilistic faults draw from a
 private ``random.Random(seed)``, scheduled faults key on the engine's
@@ -156,6 +160,31 @@ class FaultInjector:
                               count=count, after_tokens=int(after_tokens)))
         return self
 
+    def wrong_token(self, *, replica: Optional[str] = None,
+                    after_tokens: int = 0,
+                    count: Optional[int] = None) -> "FaultInjector":
+        """Corrupt tokens a replica server emits (``token ^ 1``) from
+        stream index ``after_tokens`` on — the **silent correctness
+        fault** no latency gauge sees and the synthetic canary exists to
+        catch (a drifting quantized replica, a bad KV import, a flaky
+        link flipping bits). Consulted by ``ReplicaServer(faults=...)``
+        via :meth:`corrupt_token`. ``count`` bounds how many tokens are
+        corrupted in total (None = every eligible token until
+        :meth:`clear_network`)."""
+        self._net.append(dict(kind="wrong_token", replica=replica,
+                              count=count, after_tokens=int(after_tokens)))
+        return self
+
+    def clear_network(self, kind: Optional[str] = None) -> int:
+        """Disarm network-level faults (all, or one ``kind``) — how a
+        drill 'fixes' the injected fault so recovery paths (canary
+        pending→firing→**resolved**) can be asserted. Returns how many
+        faults were removed."""
+        keep = [f for f in self._net if kind is not None and f["kind"] != kind]
+        removed = len(self._net) - len(keep)
+        self._net[:] = keep
+        return removed
+
     # -- router transport hooks ---------------------------------------------
 
     def _net_fire(self, fault: dict) -> bool:
@@ -186,6 +215,26 @@ class FaultInjector:
                 raise ConnectionRefusedError(
                     f"injected connection refusal to replica {replica!r}"
                 )
+
+    def corrupt_token(self, replica: str, index: int, token: int) -> int:
+        """Replica-server hook, per emitted token: an armed
+        ``wrong_token`` fault flips the low bit of eligible tokens. The
+        stream framing stays valid — only the *content* lies, which is
+        exactly the failure class passive telemetry cannot see."""
+        for fault in self._net:
+            if fault["kind"] != "wrong_token":
+                continue
+            if fault["replica"] is not None and fault["replica"] != replica:
+                continue
+            if index < fault["after_tokens"]:
+                continue
+            if fault["count"] is not None:
+                if fault["count"] <= 0:
+                    continue
+                fault["count"] -= 1
+            self.log.append((self._net_calls, "wrong_token", (replica, index)))
+            return int(token) ^ 1
+        return int(token)
 
     def on_stream_event(self, replica: str, index: int):
         """Router hook, per received stream token: an armed

@@ -22,6 +22,9 @@ places onto, fails over between, and scales elastically:
   which is exactly what the router's ``FleetCollector`` polls for
   health + placement.
 - ``GET /v1/health`` — a one-shot JSON health/identity document.
+- ``POST /v1/flight`` — remote-triggered flight-recorder dump
+  (``{reason}``): how the canary prober captures the degraded
+  replica's debug bundle while the fault is still live.
 
 Lifecycle: ``start()`` runs the engine's scheduler loop on a background
 thread (all device dispatches stay on that one thread; the KV endpoints
@@ -75,10 +78,14 @@ class ReplicaServer:
     the resolved one from ``.port``."""
 
     def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
-                 name: Optional[str] = None, handle_signals: bool = False):
+                 name: Optional[str] = None, handle_signals: bool = False,
+                 faults=None):
         import http.server
 
         self.engine = engine
+        # replica-side fault injection (wrong-token corruption drills):
+        # consulted per emitted token via corrupt_token()
+        self._faults = faults
         if name:
             engine.replica = str(name)
         self.name = engine.replica or f"replica@{port}"
@@ -264,8 +271,23 @@ class ReplicaServer:
             self._handle_kv_export(handler, body)
         elif handler.path == "/v1/kv/import":
             self._handle_kv_import(handler, body)
+        elif handler.path == "/v1/flight":
+            self._handle_flight(handler, body)
         else:
             handler.send_error(404)
+
+    def _handle_flight(self, handler, body: dict):
+        """Remote-triggered flight dump: the canary prober (or an
+        operator's curl) captures THIS replica's debug bundle while a
+        fault is live — the bundle names in-flight requests, recent
+        gauges, and the engine's last decisions."""
+        reason = str(body.get("reason") or "remote_request")[:64]
+        try:
+            dumped = bool(self.engine.flight_dump(reason))
+        except Exception:
+            dumped = False
+        self._send_json(handler, {"ok": dumped, "replica": self.name,
+                                  "reason": reason})
 
     # -- submit / stream ----------------------------------------------------
 
@@ -325,9 +347,15 @@ class ReplicaServer:
                     return  # mid-stream drop: connection closes, no "done"
                 n = len(req.tokens)
                 while sent < n:
+                    token = int(req.tokens[sent])
+                    if self._faults is not None:
+                        # wrong-token drill: the engine computed the right
+                        # answer, the wire lies — canary territory
+                        token = int(self._faults.corrupt_token(
+                            self.name, sent, token
+                        ))
                     line = json.dumps({
-                        "event": "token", "i": sent,
-                        "token": int(req.tokens[sent]),
+                        "event": "token", "i": sent, "token": token,
                         "request_id": req.id, "replica": self.name,
                     })
                     handler.wfile.write((line + "\n").encode())

@@ -33,6 +33,16 @@ no accelerator stack.
   ``shed_reason="router_queue_full"`` (a value, not an exception, same
   as the engine's admission control); no-replica and retries-exhausted
   paths shed too. The router never stalls a caller indefinitely.
+- **golden signals** — client-observed streaming histograms (TTFT, ITL,
+  e2e, queue-wait, placement wall, backoff wait) on the shared
+  log-bucket layout (``telemetry/histograms.py``), rendered natively on
+  ``/metrics`` so a fleet collector exact-merges them; per-hop timing
+  stamps (``place_start``/``connect``/``first_token`` on the router's
+  one clock) feeding the latency waterfall (``telemetry/waterfall.py``);
+  and a bounded **placement-decision log** (``router-decisions.jsonl``:
+  request, candidate scores, chosen replica, affinity reason) answering
+  "why was it placed THERE". ``RouterConfig(instrument=False)`` is the
+  zero-overhead baseline the tier-1 witness compares against.
 - **elastic membership** — replicas register/deregister at runtime
   (HTTP ``/v1/register`` // ``/v1/deregister`` or
   :meth:`Router.register_replica`); a draining replica takes no new
@@ -50,6 +60,7 @@ drills and multi-replica kill drills.
 from __future__ import annotations
 
 import json
+import os
 import random
 import threading
 import time
@@ -57,6 +68,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..telemetry.fleet import DOWN_STATES, FleetCollector
+from ..telemetry.histograms import StreamingHistogram, percentile_keys
 from .faults import StreamDropped
 
 # terminal router shed reasons (same bounded-vocabulary contract as the
@@ -100,6 +112,11 @@ class RouterConfig:
     failure_cooldown_s: float = 10.0  # in-flight failure excludes this long
     affinity: bool = True             # session -> last-replica stickiness
     migrate_session_kv: bool = True   # KV handoff when a session moves
+    # -- golden signals (docs/telemetry.md "Router golden signals") --------
+    instrument: bool = True           # hop stamps + histograms + decisions
+    log_dir: Optional[str] = None     # router-requests.jsonl / router-decisions.jsonl
+    decision_log_max: int = 256       # bounded in-memory decision ring
+    decision_candidates_max: int = 8  # candidate-score rows kept per decision
 
 
 @dataclass(eq=False)
@@ -128,6 +145,7 @@ class RouterRequest:
     prefix_hit: int = 0
     submit_t: float = 0.0
     first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None
     finish_t: Optional[float] = None
 
     @property
@@ -264,6 +282,32 @@ class Router:
         self.requeue_success = 0    # ...and still finished
         self.kv_migrations = 0
         self.replica_failures: dict = {}       # name -> count
+        self.shed_reason_counts: dict = {}     # reason -> count
+        # golden signals: client-observed streaming histograms (the same
+        # log-bucket layout every session uses, so the fleet collector
+        # exact-merges the native /metrics buckets across routers) + the
+        # bounded placement-decision ring. config.instrument=False is the
+        # zero-overhead witness baseline the tier-1 drill compares against.
+        self.instrument = bool(self.config.instrument)
+        self.hists: dict = {}
+        if self.instrument:
+            for key in ("router/ttft", "router/itl", "router/e2e",
+                        "router/queue_wait", "router/placement",
+                        "router/backoff_wait"):
+                self.hists[key] = StreamingHistogram()
+        self.decisions: list = []   # bounded ring of placement decisions
+        self.canary = None          # optional attached CanaryProber
+        self._log_lock = threading.Lock()
+        self._decisions_fh = None
+        self._requests_fh = None
+        if self.config.log_dir and self.instrument:
+            os.makedirs(self.config.log_dir, exist_ok=True)
+            self._decisions_fh = open(
+                os.path.join(self.config.log_dir, "router-decisions.jsonl"), "a"
+            )
+            self._requests_fh = open(
+                os.path.join(self.config.log_dir, "router-requests.jsonl"), "a"
+            )
 
     @staticmethod
     def _metrics_target(base_url: str) -> str:
@@ -301,7 +345,102 @@ class Router:
         return self
 
     def close(self):
+        if self.canary is not None:
+            try:
+                self.canary.close()
+            except Exception:
+                pass
         self.collector.close()
+        with self._log_lock:
+            for fh in (self._decisions_fh, self._requests_fh):
+                if fh is not None:
+                    try:
+                        fh.close()
+                    except OSError:
+                        pass
+            self._decisions_fh = self._requests_fh = None
+
+    # -- golden signals ------------------------------------------------------
+
+    def _observe(self, key: str, seconds: float):
+        h = self.hists.get(key)
+        if h is not None:
+            h.add(seconds)
+
+    def _note_decision(self, req: RouterRequest, hop_index: int,
+                       chosen: str, rows: list, excluded, reason: str,
+                       now: float):
+        """One placement decision: who won, why, and the candidate-score
+        snapshot it won against — the 'why was it placed THERE' record a
+        latency regression triage starts from."""
+        entry = {
+            "t_unix_s": round(now, 3),
+            "request_id": req.id,
+            "hop": int(hop_index),
+            "session": req.session,
+            "chosen": chosen,
+            "reason": reason,
+            "excluded": [str(e) for e in excluded],
+            "candidates": [
+                {"replica": r.get("replica"),
+                 "load_score": r.get("load_score"),
+                 "state": r.get("state"),
+                 "placeable": bool(r.get("placeable", True))}
+                for r in rows[: self.config.decision_candidates_max]
+            ],
+        }
+        with self._log_lock:
+            self.decisions.append(entry)
+            cap = max(1, int(self.config.decision_log_max))
+            if len(self.decisions) > cap:
+                del self.decisions[: len(self.decisions) - cap]
+            fh = self._decisions_fh
+            if fh is not None:
+                try:
+                    fh.write(json.dumps(entry) + "\n")
+                    fh.flush()
+                except OSError:
+                    pass
+
+    def _finalize(self, req: RouterRequest):
+        """Terminal bookkeeping for every outcome path: the e2e
+        histogram and the router request record (the waterfall's
+        router-side half)."""
+        if not self.instrument:
+            return
+        if req.finish_t is not None:
+            self._observe("router/e2e", max(0.0, req.finish_t - req.submit_t))
+        fh = self._requests_fh
+        if fh is None:
+            return
+        rec = {
+            "request_id": req.id,
+            "session": req.session,
+            "tenant": req.tenant,
+            "submit_unix_s": round(req.submit_t, 6),
+            "outcome": req.outcome,
+            "finish_reason": req.finish_reason,
+            "shed_reason": req.shed_reason,
+            "replica": req.replica,
+            "tokens": len(req.tokens),
+            "requeues": sum(1 for h in req.hops if "error" in h),
+            "ttft_ms": (
+                round((req.first_token_t - req.submit_t) * 1e3, 3)
+                if req.first_token_t is not None else None
+            ),
+            "e2e_ms": (
+                round((req.finish_t - req.submit_t) * 1e3, 3)
+                if req.finish_t is not None else None
+            ),
+            "hops": req.hops,
+        }
+        with self._log_lock:
+            if self._requests_fh is not None:
+                try:
+                    self._requests_fh.write(json.dumps(rec) + "\n")
+                    self._requests_fh.flush()
+                except OSError:
+                    pass
 
     # -- placement ----------------------------------------------------------
 
@@ -322,6 +461,12 @@ class Router:
         placeable view, minus excluded/recently-failed replicas, with
         the session's sticky replica promoted to the front when it is
         still placeable. Returns replica names."""
+        return self._ranked(session, exclude)[0]
+
+    def _ranked(self, session: Optional[str], exclude=()) -> tuple:
+        """``(names, rows, sticky)`` — the ranked placement order plus
+        the score rows it was ranked from (the decision log snapshots
+        them) and the session's sticky replica (None when absent)."""
         now = self._clock()
         rows = self.collector.placement_view()
         failed = self._failed_now(now)
@@ -337,7 +482,7 @@ class Router:
         if self.config.affinity and sticky in names:
             names.remove(sticky)
             names.insert(0, sticky)
-        return names
+        return names, rows, sticky
 
     def _replica_url(self, name: str) -> Optional[str]:
         with self._lock:
@@ -438,13 +583,27 @@ class Router:
         req.finish_t = self._clock()
         with self._lock:
             self.requests_shed += 1
+            self.shed_reason_counts[reason] = (
+                self.shed_reason_counts.get(reason, 0) + 1
+            )
             if any("error" in h for h in req.hops):
                 self.requests_requeued += 1
+        self._finalize(req)
 
     def _deadline(self, req: RouterRequest, timeout_s) -> Optional[float]:
         timeout_s = timeout_s if timeout_s is not None \
             else self.config.request_timeout_s
         return req.submit_t + timeout_s if timeout_s is not None else None
+
+    def _backoff_sleep(self, seconds: float) -> float:
+        """One backoff wait, measured (the waterfall's retry_backoff
+        stage and the ``router/backoff_wait`` histogram both come from
+        the measured wall, not the nominal schedule)."""
+        t0 = self._clock()
+        time.sleep(seconds)
+        waited = max(0.0, self._clock() - t0)
+        self._observe("router/backoff_wait", waited)
+        return waited
 
     def _route(self, req: RouterRequest, timeout_s, on_token):
         cfg = self.config
@@ -455,6 +614,8 @@ class Router:
         deadline = self._deadline(req, timeout_s)
         excluded: list = []
         failures = 0
+        queued = False        # router/queue_wait observed yet?
+        backoff_pending = 0.0  # waits since the last hop (stamped on the next)
         while True:
             now = self._clock()
             if deadline is not None and now >= deadline:
@@ -465,8 +626,16 @@ class Router:
                     self.requests_cancelled += 1
                     if any("error" in h for h in req.hops):
                         self.requests_requeued += 1
+                self._finalize(req)
                 return
-            names = self.candidates(req.session, exclude=excluded)
+            place_start = now
+            if not queued:
+                queued = True
+                self._observe("router/queue_wait",
+                              max(0.0, place_start - req.submit_t))
+            names, rows, sticky = self._ranked(req.session, exclude=excluded)
+            place_end = self._clock()
+            self._observe("router/placement", max(0.0, place_end - place_start))
             if not names:
                 with self._lock:
                     any_known = bool(self._replicas)
@@ -487,7 +656,9 @@ class Router:
                 # has caught up, so a genuinely-bad replica stays out
                 # via its health state / failure cooldown while a
                 # recovered one becomes retryable again
-                time.sleep(delays[min(failures, len(delays) - 1)])
+                backoff_pending += self._backoff_sleep(
+                    delays[min(failures, len(delays) - 1)]
+                )
                 failures += 1
                 self.collector.poll_once()
                 del excluded[:]
@@ -497,19 +668,34 @@ class Router:
             if url is None:
                 excluded.append(target)
                 continue
+            if self.instrument:
+                self._note_decision(
+                    req, len(req.hops), target, rows, excluded,
+                    "affinity" if (cfg.affinity and target == sticky)
+                    else "least_loaded",
+                    place_end,
+                )
             if req.prompt and not req.tokens:
                 self._migrate_session_kv(req, target, url)
-            req.hops.append(
-                {"replica": target, "t_unix_s": round(self._clock(), 3)}
-            )
-            hop = req.hops[-1]
+            hop = {"replica": target, "t_unix_s": round(self._clock(), 3)}
+            if self.instrument:
+                # the waterfall's router-side stamps: one clock, so the
+                # stage math is pure timestamp differences (waterfall.py)
+                hop["place_start_unix_s"] = round(place_start, 6)
+                hop["placement_ms"] = round((place_end - place_start) * 1e3, 3)
+                if backoff_pending:
+                    hop["backoff_before_ms"] = round(backoff_pending * 1e3, 3)
+                backoff_pending = 0.0
+            req.hops.append(hop)
             try:
                 if self._faults is not None:
                     self._faults.before_connect(target)
+                if self.instrument:
+                    hop["connect_unix_s"] = round(self._clock(), 6)
                 done = self.transport.stream_submit(
                     url, self._hop_payload(req, deadline),
                     on_event=lambda evt: self._on_event(
-                        req, target, evt, on_token
+                        req, target, hop, evt, on_token
                     ),
                 )
             except (OSError, ConnectionError, StreamDropped) as e:
@@ -522,9 +708,13 @@ class Router:
                 if failures > cfg.max_retries:
                     self._shed(req, SHED_RETRIES_EXHAUSTED)
                     return
-                time.sleep(delays[min(failures - 1, len(delays) - 1)])
+                backoff_pending += self._backoff_sleep(
+                    delays[min(failures - 1, len(delays) - 1)]
+                )
                 continue
             # terminal event from the replica
+            if self.instrument:
+                hop["done_unix_s"] = round(self._clock(), 6)
             outcome = str(done.get("outcome") or "finished")
             if outcome == "shed" and done.get("shed_reason") == "draining":
                 # the replica started draining between the scrape and our
@@ -551,10 +741,14 @@ class Router:
                         self.requeue_success += 1
                 elif outcome == "shed":
                     self.requests_shed += 1
+                    self.shed_reason_counts[str(req.shed_reason)] = (
+                        self.shed_reason_counts.get(str(req.shed_reason), 0) + 1
+                    )
                 else:
                     self.requests_cancelled += 1
                 if req.session and outcome == "finished":
                     self._sessions[req.session] = target
+            self._finalize(req)
             return
 
     def _hop_payload(self, req: RouterRequest,
@@ -576,10 +770,13 @@ class Router:
             payload["timeout_s"] = max(0.05, deadline - self._clock())
         return payload
 
-    def _on_event(self, req: RouterRequest, replica: str, event: dict,
-                  on_token):
+    def _on_event(self, req: RouterRequest, replica: str, hop: dict,
+                  event: dict, on_token):
         if self._faults is not None and event.get("event") == "token":
             self._faults.on_stream_event(replica, int(event.get("i", 0)))
+        now = self._clock()
+        if self.instrument and "first_byte_unix_s" not in hop:
+            hop["first_byte_unix_s"] = round(now, 6)
         if event.get("event") != "token":
             return
         i = int(event["i"])
@@ -588,7 +785,15 @@ class Router:
         token = int(event["token"])
         req.tokens.append(token)
         if req.first_token_t is None:
-            req.first_token_t = self._clock()
+            # client-observed TTFT: submit at the router to first NEW
+            # token back at the router — the number the user felt
+            req.first_token_t = now
+            if self.instrument:
+                hop["first_token_unix_s"] = round(now, 6)
+                self._observe("router/ttft", max(0.0, now - req.submit_t))
+        elif req.last_token_t is not None:
+            self._observe("router/itl", max(0.0, now - req.last_token_t))
+        req.last_token_t = now
         if on_token is not None:
             on_token(token, req)
 
@@ -617,7 +822,26 @@ class Router:
             }
             for name, n in sorted(self.replica_failures.items()):
                 out[f"router/failures/{name}"] = n
+            for reason, n in sorted(self.shed_reason_counts.items()):
+                out[f"router/shed/{reason}"] = n
+        # golden-signal percentiles ride the rollup the same way the
+        # engine's serving/* histograms do (the native buckets are also
+        # exposed on /metrics, so the fleet collector exact-merges them)
+        for name, hist in self.hists.items():
+            out.update(percentile_keys(name, hist))
+        if self.canary is not None:
+            try:
+                out.update(self.canary.rollup_keys())
+            except Exception:
+                pass  # a sick prober must not fail the scrape
         return out
+
+    def attach_canary(self, prober) -> "Router":
+        """Publish an attached :class:`~..telemetry.canary.CanaryProber`'s
+        ``canary/*`` gauges through this router's ``/metrics`` (the
+        prober's lifecycle joins ``close()``)."""
+        self.canary = prober
+        return self
 
 
 class _RouterMetricsSession:
@@ -626,7 +850,9 @@ class _RouterMetricsSession:
 
     def __init__(self, router: Router):
         self.router = router
-        self.hists: dict = {}
+        # the golden-signal histograms render natively (_bucket{le=...})
+        # so a FleetCollector scraping N routers exact-merges quantiles
+        self.hists = router.hists
         self.alerts = None
         self.last_sample_unix_s = None  # counters are live, not sampled
 

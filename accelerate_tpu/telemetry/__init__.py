@@ -71,6 +71,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from .canary import CanaryProber  # noqa: F401 (public API)
 from .histograms import StreamingHistogram, percentile_keys  # noqa: F401
 from .metrics import MetricsWindow, batch_token_count, flops_per_token_fn
 from .spans import SpanRecorder, load_chrome_trace, span  # noqa: F401 (public API)

@@ -29,8 +29,11 @@ already exists: ``"flight_dump"`` (FlightRecorder debug bundle),
 
 :func:`default_ruleset` covers the failure modes this stack has already
 built detectors for: ITL SLO burn, shed-rate burn, goodput
-compute-fraction collapse, recompile storms, and the page-arena
-watermark (docs/telemetry.md has the tuning guide).
+compute-fraction collapse, recompile storms, the page-arena watermark,
+and the synthetic-canary correctness check (``canary_failing`` pages on
+``canary/pass_ratio`` dropping below 1 — the active prober in
+``telemetry/canary.py``; a missing series never fires, so sessions with
+no canary pay nothing). docs/telemetry.md has the tuning guide.
 
 Plain stdlib, no jax/numpy (locked by tests/test_imports.py).
 """
@@ -231,6 +234,8 @@ def default_ruleset(
     goodput_for_s: float = 60.0,
     recompile_burst: float = 2.0,
     recompile_window_s: float = 120.0,
+    canary_pass_floor: float = 1.0,
+    canary_for_s: float = 0.0,
 ) -> list:
     """The built-in ruleset: every detector this stack already measures,
     promoted to an alert. ITL/TTFT burn rules only exist when their SLO
@@ -284,6 +289,20 @@ def default_ruleset(
         description="compute fraction of wall collapsed while the step "
                     "loop is live — look at compile/data_wait/stall",
         severity="warn",
+    ))
+    rules.append(AlertRule(
+        name="canary_failing",
+        key="canary/pass_ratio", op="<", threshold=canary_pass_floor,
+        for_s=canary_for_s,
+        description="synthetic canary probes are returning wrong tokens "
+                    "or not finishing — an ACTIVE correctness failure "
+                    "(drift? bad KV import? corrupting transport?); "
+                    "canary-results.jsonl names the replica that served "
+                    "each failing probe, and its flight bundle was "
+                    "dumped at failure time (docs/troubleshooting.md "
+                    "'The canary is failing')",
+        severity="page",
+        actions=("flight_dump",),
     ))
     rules.append(AlertRule(
         name="recompile_storm",

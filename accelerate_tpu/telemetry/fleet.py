@@ -202,7 +202,7 @@ def parse_exposition(text: str) -> ExpositionSnapshot:
 # unflatten_key restores the namespace so fleet-timeline keys match the
 # per-replica rollup keys and AlertRule/BurnRateRule evaluate unchanged
 _NAMESPACES = ("serving", "usage", "goodput", "sys", "exe", "alerts",
-               "fleet", "train", "fp8")
+               "fleet", "train", "fp8", "router", "canary")
 
 
 def unflatten_key(name: str) -> str:
@@ -301,7 +301,9 @@ MAX = "max"                   # watermarks / ages: fleet-worst
 MEAN = "mean"                 # fractions / ratios: fleet-average
 
 # monotone counters by exact key — these keep a dead replica's last-known
-# contribution so fleet totals are conserved across a loss
+# contribution so fleet totals are conserved across a loss. The router/*
+# and canary/* families joined with the edge-observability PR: N routers
+# (or a router + a standalone prober) merge the same way N engines do.
 _COUNTER_KEYS = frozenset({
     "serving/requests_completed", "serving/generated_tokens",
     "serving/requests_terminal", "serving/shed", "serving/cancelled",
@@ -312,10 +314,25 @@ _COUNTER_KEYS = frozenset({
     "serving/itl_slo_breaches", "serving/itl_budget_adjustments",
     "serving/kv_pages_exported", "serving/kv_pages_imported",
     "sys/recompiles_diagnosed", "fleet/scrapes_ok", "fleet/scrapes_failed",
+    "router/requests_submitted", "router/requests_completed",
+    "router/requests_shed", "router/requests_cancelled",
+    "router/requeues", "router/requests_requeued",
+    "router/requeue_success", "router/kv_migrations",
+    "canary/probes_sent", "canary/probes_passed", "canary/probes_failed",
 })
+# per-member counter families under a dynamic tail (tenant ids, replica
+# names, shed reasons): counters by prefix. No trailing slash on the
+# router families — a scraped gauge unflattens only its leading
+# namespace ("router/failures_A"), while an in-process rollup keeps the
+# full path ("router/failures/A"); both must land on SUM_COUNTER.
+_COUNTER_PREFIXES = ("usage/", "router/failures", "router/shed")
 _MEAN_SUFFIXES = ("_frac", "_ratio", "_pct", "occupancy", "_rate",
                   "load_score", "itl_budget", "kv_cache_bits")
-_MAX_SUFFIXES = ("_age_seconds", "_watermark", "draining", "_age_s")
+# last_pass_unix_s: the canary freshness watermark is "when did ANY
+# probe last verify the service" — fleet-newest; e2e_ttft_ms gauges are
+# last-probe latencies — fleet-worst
+_MAX_SUFFIXES = ("_age_seconds", "_watermark", "draining", "_age_s",
+                 "last_pass_unix_s", "e2e_ttft_ms")
 # percentile/latency gauges: fleet-worst unless the native histogram
 # buckets are available, in which case the exact merged quantile wins
 # (covers both the rollup spelling `*_p99_ms` and the exposition's
@@ -329,7 +346,8 @@ def merge_policy(key: str) -> str:
     same table): counters sum over every replica ever seen, capacities
     and rates sum over live replicas, fractions average, watermarks and
     latency gauges take the fleet-worst."""
-    if key in _COUNTER_KEYS or key.startswith("usage/") or key.endswith("_count"):
+    if (key in _COUNTER_KEYS or key.startswith(_COUNTER_PREFIXES)
+            or key.endswith("_count")):
         return SUM_COUNTER
     if key.endswith(_MAX_SUFFIXES) or key.endswith(_LATENCY_SUFFIXES):
         return MAX

@@ -1521,6 +1521,30 @@ def _router_failover_bench(cfg, prompt_len, *, page_size=16, num_slots=2,
         clean, clean_ttfts, _ = wave(False)
         assert all(r.outcome == "finished" for r in clean)
         base_ms = 1e3 * float(np.median([t for t in clean_ttfts if t]))
+        # edge golden signals off the clean wave: the client-observed
+        # router TTFT p99 (the router's own streaming histogram — what
+        # `report --diff` watches as the edge-latency regression row)
+        ttft_hist = router.hists.get("router/ttft")
+        snap = ttft_hist.snapshot() if ttft_hist is not None else {}
+        e2e_ttft_p99_ms = (
+            round(snap["p99_s"] * 1e3, 2) if snap else None
+        )
+        # ...and a short synthetic-canary run through the router: the
+        # first probe records the golden tokens, the rest must reproduce
+        # them token-exactly (correctness sentinel: any drop below 1.0
+        # trips `report --diff --fail` regardless of threshold)
+        from accelerate_tpu.telemetry.canary import CanaryProber, via_router
+
+        prober = CanaryProber(
+            via_router(router),
+            [{"prompt": [int(t) for t in prompts[0]], "seed": 1234,
+              "max_new_tokens": max_new}],
+            interval_s=60.0,
+        )
+        for _ in range(3):
+            prober.probe_once()
+        canary_pass_ratio = prober.pass_ratio()
+        prober.close()
         killed, kill_ttfts, victim = wave(True)
         requeued = [
             (r, t) for r, t in zip(killed, kill_ttfts)
@@ -1539,7 +1563,14 @@ def _router_failover_bench(cfg, prompt_len, *, page_size=16, num_slots=2,
                 / len(requeued) if requeued else 1.0
             ),
             "survivor_recompiles": survivor.admission_recompiles,
+            "canary_pass_ratio": canary_pass_ratio,
         }
+        if e2e_ttft_p99_ms is not None:
+            out["router_e2e_ttft_p99_ms"] = e2e_ttft_p99_ms
+        assert canary_pass_ratio == 1.0, (
+            "the synthetic canary failed token-exactness on a healthy "
+            "2-replica fleet — determinism regression"
+        )
         if requeued:
             rq_ms = 1e3 * float(np.median(
                 [t for _, t in requeued if t is not None]
@@ -1892,6 +1923,15 @@ def main():
         extra["router_requeue_success_rate"] = (
             extra["router_failover"]["router_requeue_success_rate"]
         )
+        # edge golden-signal rows: client-observed router TTFT p99 +
+        # the synthetic-canary correctness sentinel (report --diff
+        # flags ANY pass-ratio drop, threshold or not)
+        extra["router_e2e_ttft_p99_ms"] = (
+            extra["router_failover"].get("router_e2e_ttft_p99_ms")
+        )
+        extra["canary_pass_ratio"] = (
+            extra["router_failover"]["canary_pass_ratio"]
+        )
         # the transfer_flush noise rows (median-of-rounds + spread; the
         # best-attempt phase breakdown above keeps the old shape)
         for v in ("bf16", "int8", "int4"):
@@ -2010,6 +2050,12 @@ def main():
         )
         extra["router_requeue_success_rate"] = (
             extra["router_failover"]["router_requeue_success_rate"]
+        )
+        extra["router_e2e_ttft_p99_ms"] = (
+            extra["router_failover"].get("router_e2e_ttft_p99_ms")
+        )
+        extra["canary_pass_ratio"] = (
+            extra["router_failover"]["canary_pass_ratio"]
         )
 
     # static-audit regression rows (both branches; post-warmup pass)

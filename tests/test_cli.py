@@ -414,6 +414,105 @@ class TestTraceCommand:
         assert r.returncode == 1 and "999" in r.stderr
 
 
+class TestWaterfallCli:
+    """`trace summary --waterfall` + the report waterfall/canary
+    sections + the canary_pass_ratio diff sentinel (the on-disk formats
+    are pinned here; the live writers are covered in test_canary.py)."""
+
+    def _edge_dir(self, tmp_path):
+        t0 = 1_700_000_000.0
+
+        def rrec(i, replica, prefill_ms):
+            ft = t0 + i + 0.004 + (prefill_ms + 5.0) / 1e3
+            return {
+                "request_id": f"w{i}", "submit_unix_s": t0 + i,
+                "outcome": "finished", "replica": replica,
+                "ttft_ms": round((ft - (t0 + i)) * 1e3, 3),
+                "e2e_ms": round((ft - (t0 + i)) * 1e3 + 10, 3),
+                "tokens": 4, "requeues": 0,
+                "hops": [{
+                    "replica": replica, "t_unix_s": t0 + i,
+                    "place_start_unix_s": t0 + i + 0.001,
+                    "placement_ms": 1.0,
+                    "connect_unix_s": t0 + i + 0.002,
+                    "first_token_unix_s": ft,
+                }],
+            }
+
+        rows = [rrec(0, "A", 20.0), rrec(1, "A", 22.0), rrec(2, "B", 150.0)]
+        with open(tmp_path / "router-requests.jsonl", "w") as fh:
+            fh.write("\n".join(json.dumps(r) for r in rows) + "\n")
+        reps = [{"request_id": f"w{i}", "replica": r["replica"],
+                 "queue_wait_ms": 5.0,
+                 "ttft_ms": 5.0 + (150.0 if r["replica"] == "B" else 20.0)}
+                for i, r in enumerate(rows)]
+        with open(tmp_path / "requests-host0.jsonl", "w") as fh:
+            fh.write("\n".join(json.dumps(r) for r in reps) + "\n")
+        canary = [
+            {"t_unix_s": t0, "request_id": "canary-0", "golden": 0,
+             "replica": "A", "passed": True, "reason": "recorded"},
+            {"t_unix_s": t0 + 1, "request_id": "canary-1", "golden": 0,
+             "replica": "B", "passed": False,
+             "reason": "token mismatch at index 0"},
+        ]
+        with open(tmp_path / "canary-results.jsonl", "w") as fh:
+            fh.write("\n".join(json.dumps(r) for r in canary) + "\n")
+        return tmp_path
+
+    def test_waterfall_table_and_json(self, tmp_path):
+        d = self._edge_dir(tmp_path)
+        r = _run(["trace", "summary", str(d), "--waterfall"])
+        assert r.returncode == 0, r.stderr
+        assert "prefill_ms" in r.stdout and "per-stage aggregate" in r.stdout
+        assert "top stage by request" in r.stdout
+        r = _run(["trace", "summary", str(d), "--waterfall", "--json"])
+        data = json.loads(r.stdout)
+        assert data["aggregate"]["requests"] == 3
+        for row in data["waterfalls"]:
+            assert sum(row["stages"].values()) == pytest.approx(
+                row["e2e_ttft_ms"], abs=0.02
+            )
+        slow = next(r for r in data["waterfalls"] if r["replica"] == "B")
+        assert slow["top_stage"] == "prefill"
+
+    def test_waterfall_without_router_log_fails_cleanly(self, tmp_path):
+        r = _run(["trace", "summary", str(tmp_path), "--waterfall"])
+        assert r.returncode == 1 and "router-requests" in r.stderr
+
+    def test_report_renders_waterfall_and_canary_sections(self, tmp_path):
+        d = self._edge_dir(tmp_path)
+        r = _run(["report", str(d)])
+        assert r.returncode == 0, r.stderr
+        assert "request waterfall" in r.stdout
+        assert "prefill" in r.stdout
+        assert "canary: 2 probe(s), 1 failed" in r.stdout
+        assert "failing probes served by B: 1" in r.stdout
+        r = _run(["report", str(d), "--json"])
+        data = json.loads(r.stdout)
+        assert data["waterfall"]["requests"] == 3
+        assert data["canary"]["failing_replicas"] == {"B": 1}
+
+    def test_canary_pass_ratio_drop_is_a_sentinel(self):
+        from accelerate_tpu.commands.report import diff_metrics
+
+        # a 2% ratio drop is far under the 10% threshold — flagged anyway
+        diff = diff_metrics({"canary_pass_ratio": 1.0, "other": 100.0},
+                            {"canary_pass_ratio": 0.98, "other": 101.0},
+                            threshold=0.1)
+        flagged = {r["metric"] for r in diff["flagged"]}
+        assert flagged == {"canary_pass_ratio"}
+        assert diff["flagged"][0]["sentinel"]
+        # a ratio RISE is not a regression
+        diff = diff_metrics({"canary_pass_ratio": 0.9},
+                            {"canary_pass_ratio": 1.0}, threshold=0.5)
+        assert not diff["flagged"]
+        # the TTFT row diffs under the normal threshold rules
+        diff = diff_metrics({"router_e2e_ttft_p99_ms": 100.0},
+                            {"router_e2e_ttft_p99_ms": 150.0}, threshold=0.1)
+        assert [r["metric"] for r in diff["flagged"]] \
+            == ["router_e2e_ttft_p99_ms"]
+
+
 class TestReportCommand:
     """`accelerate-tpu report` over the telemetry dir's explanatory
     artifacts (goodput ledger, cost registry, forensics JSONL); as with

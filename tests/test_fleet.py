@@ -176,6 +176,31 @@ class TestMergePolicy:
         assert merge_policy("serving/prefix_hit_ratio") == "mean"
         assert merge_policy("serving/itl_p99_ms") == "max"
         assert merge_policy("scrape_age_seconds") == "max"
+        # the router/* family (a router scrape merges like a replica's):
+        # counters sum over last-known, including the dynamic-tail
+        # families in BOTH spellings (raw rollup `router/shed/x` and the
+        # exposition-unflattened `router/shed_x`), gauges stay live-summed,
+        # latency percentiles fleet-worst (exact-merged when buckets land)
+        assert merge_policy("router/requests_submitted") == "sum_counter"
+        assert merge_policy("router/requests_completed") == "sum_counter"
+        assert merge_policy("router/requeues") == "sum_counter"
+        assert merge_policy("router/kv_migrations") == "sum_counter"
+        assert merge_policy("router/failures/replicaB") == "sum_counter"
+        assert merge_policy("router/failures_replicaB") == "sum_counter"
+        assert merge_policy("router/shed/router_queue_full") == "sum_counter"
+        assert merge_policy("router/shed_router_queue_full") == "sum_counter"
+        assert merge_policy("router/inflight") == "sum_live"
+        assert merge_policy("router/replicas") == "sum_live"
+        assert merge_policy("router/ttft_p99_ms") == "max"
+        assert merge_policy("router/ttft_count") == "sum_counter"
+        # the canary/* family: probe counters sum, the recent pass ratio
+        # averages, freshness and last-probe TTFT take the fleet max
+        assert merge_policy("canary/probes_sent") == "sum_counter"
+        assert merge_policy("canary/probes_passed") == "sum_counter"
+        assert merge_policy("canary/probes_failed") == "sum_counter"
+        assert merge_policy("canary/pass_ratio") == "mean"
+        assert merge_policy("canary/last_pass_unix_s") == "max"
+        assert merge_policy("canary/e2e_ttft_ms") == "max"
 
     def test_counters_conserve_across_dead_replica(self):
         a = {"serving/generated_tokens": 40, "serving/queue_depth": 2,

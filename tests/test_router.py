@@ -480,6 +480,160 @@ class TestKvMigration:
         assert router.kv_migrations == 0
 
 
+class TestGoldenSignals:
+    """Router edge observability: client-observed histograms, per-hop
+    timing stamps, the placement-decision log, the router request log,
+    and the instrument=False zero-overhead baseline."""
+
+    def test_histograms_and_hop_stamps_on_a_finished_request(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", load=0.05)
+        router.collector.poll_once()
+        transport.script("A", tokens=[7, 8, 9])
+        req = router.submit([1, 2], max_new_tokens=3, seed=0)
+        assert req.outcome == "finished"
+        for key in ("router/ttft", "router/e2e", "router/queue_wait",
+                    "router/placement"):
+            assert router.hists[key].count >= 1, key
+        assert router.hists["router/itl"].count == 2  # 3 tokens -> 2 gaps
+        hop = req.hops[0]
+        assert hop["place_start_unix_s"] <= hop["connect_unix_s"]
+        assert hop["connect_unix_s"] <= hop["first_byte_unix_s"]
+        assert hop["first_token_unix_s"] <= hop["done_unix_s"]
+        assert hop["placement_ms"] >= 0.0
+        m = router.metrics()
+        assert m["router/ttft_count"] == 1
+        assert "router/ttft_p99_ms" in m and "router/e2e_p99_ms" in m
+
+    def test_backoff_wait_is_measured_and_stamped(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", load=0.05)
+        router.collector.poll_once()
+        transport.script("A", refuse=True)
+        transport.script("B", tokens=[1])
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.outcome == "finished" and req.replica == "B"
+        assert router.hists["router/backoff_wait"].count == 1
+        # the wait between the failed hop and the retry is stamped on
+        # the hop it delayed — the waterfall's retry_backoff source
+        assert req.hops[1]["backoff_before_ms"] > 0.0
+
+    def test_decision_log_names_choice_reason_and_candidates(self):
+        router, fleet, transport = make_router()
+        fleet.set("A", load=0.05)
+        fleet.set("B", load=2.0)
+        router.collector.poll_once()
+        transport.script("A", tokens=[1])
+        transport.script("B", tokens=[1])
+        r1 = router.submit([1], max_new_tokens=1, seed=0, session="s")
+        assert r1.replica == "A"
+        d = router.decisions[-1]
+        assert d["chosen"] == "A" and d["reason"] == "least_loaded"
+        assert d["request_id"] == r1.id and d["hop"] == 0
+        scores = {c["replica"]: c["load_score"] for c in d["candidates"]}
+        assert scores["A"] < scores["B"]
+        # second request on the session: affinity is the recorded reason
+        r2 = router.submit([1], max_new_tokens=1, seed=0, session="s")
+        assert router.decisions[-1]["reason"] == "affinity"
+        assert router.decisions[-1]["chosen"] == r2.replica == "A"
+
+    def test_decision_ring_is_bounded(self):
+        router, fleet, transport = make_router(
+            config=RouterConfig(backoff_base_s=0.001, decision_log_max=5)
+        )
+        transport.script("A", tokens=[1])
+        transport.script("B", tokens=[1])
+        for i in range(12):
+            router.submit([i], max_new_tokens=1, seed=0)
+        assert len(router.decisions) == 5
+
+    def test_log_dir_writes_requests_and_decisions(self, tmp_path):
+        router, fleet, transport = make_router(
+            config=RouterConfig(backoff_base_s=0.001,
+                                log_dir=str(tmp_path), max_inflight=1)
+        )
+        fleet.set("A", load=0.05)
+        router.collector.poll_once()
+        transport.script("A", tokens=[4, 5])
+        req = router.submit([1], max_new_tokens=2, seed=0)
+        assert req.outcome == "finished"
+        router.close()
+        with open(tmp_path / "router-requests.jsonl") as fh:
+            recs = [json.loads(l) for l in fh if l.strip()]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["request_id"] == req.id and rec["outcome"] == "finished"
+        assert rec["tokens"] == 2 and rec["replica"] == "A"
+        assert rec["ttft_ms"] is not None and rec["e2e_ms"] >= rec["ttft_ms"]
+        assert rec["hops"][0]["connect_unix_s"] > 0
+        with open(tmp_path / "router-decisions.jsonl") as fh:
+            decs = [json.loads(l) for l in fh if l.strip()]
+        assert decs and decs[0]["chosen"] == "A"
+
+    def test_shed_requests_are_recorded_with_reason_counters(self, tmp_path):
+        router, fleet, transport = make_router(
+            config=RouterConfig(max_inflight=0, log_dir=str(tmp_path))
+        )
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.shed_reason == SHED_ROUTER_QUEUE_FULL
+        m = router.metrics()
+        assert m["router/shed/router_queue_full"] == 1
+        router.close()
+        with open(tmp_path / "router-requests.jsonl") as fh:
+            rec = json.loads(fh.readline())
+        assert rec["outcome"] == "shed"
+        assert rec["shed_reason"] == SHED_ROUTER_QUEUE_FULL
+        assert rec["ttft_ms"] is None
+
+    def test_instrument_false_is_the_bare_baseline(self, tmp_path):
+        router, fleet, transport = make_router(
+            config=RouterConfig(backoff_base_s=0.001, instrument=False,
+                                log_dir=str(tmp_path))
+        )
+        transport.script("A", tokens=[1])
+        transport.script("B", tokens=[1])
+        req = router.submit([1], max_new_tokens=1, seed=0)
+        assert req.outcome == "finished"
+        assert router.hists == {}
+        assert router.decisions == []
+        assert "place_start_unix_s" not in req.hops[0]
+        assert not (tmp_path / "router-requests.jsonl").exists()
+        assert not any(k.endswith("_p99_ms") for k in router.metrics())
+
+    def test_metrics_endpoint_renders_native_histograms(self):
+        from accelerate_tpu.serving.router import _RouterMetricsSession
+        from accelerate_tpu.telemetry.exporter import prometheus_text
+
+        router, fleet, transport = make_router()
+        transport.script("A", tokens=[1, 2])
+        transport.script("B", tokens=[1, 2])
+        router.submit([1], max_new_tokens=2, seed=0)
+        text = prometheus_text(_RouterMetricsSession(router))
+        # native buckets -> a FleetCollector exact-merges router quantiles
+        assert "att_router_ttft_seconds_bucket{le=" in text
+        assert "att_router_ttft_seconds_count 1" in text
+        assert "att_router_requests_completed 1" in text
+
+    def test_canary_gauges_ride_the_router_rollup(self):
+        router, fleet, transport = make_router()
+        transport.script("A", tokens=[6, 7])
+        transport.script("B", tokens=[6, 7])
+
+        from accelerate_tpu.telemetry.canary import CanaryProber, via_router
+
+        prober = CanaryProber(
+            via_router(router),
+            [{"prompt": [1, 2], "seed": 0, "max_new_tokens": 2}],
+        )
+        router.attach_canary(prober)
+        prober.probe_once()  # records the golden
+        prober.probe_once()  # verifies it
+        m = router.metrics()
+        assert m["canary/probes_sent"] == 2
+        assert m["canary/pass_ratio"] == 1.0
+        assert m["canary/last_pass_unix_s"] > 0
+
+
 class TestRouterServerHttp:
     """The stdlib front door end to end against a fake JSONL replica —
     no jax, real sockets."""

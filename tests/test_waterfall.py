@@ -1,0 +1,222 @@
+"""Latency-waterfall stage math (accelerate_tpu/telemetry/waterfall.py)
+— jax-free, hand-built records with known timestamps.
+
+The contracts of record:
+- the stages sum EXACTLY to the client-observed end-to-end TTFT (the
+  whole point: a p99 regression is attributable to a stage, and the
+  stages never account for more or less time than the client felt);
+- replica-side stages are durations, so replica clock skew — even
+  minutes of it, far past what the PR 11 ``epoch_unix_s`` anchor ever
+  sees — cannot break the sum, only shift weight between transport and
+  the replica stages;
+- a re-queued request's failed hops + backoff land in retry_backoff;
+- the per-stage aggregate's shares sum to 1 and the top stage names the
+  regression.
+"""
+
+import json
+
+import pytest
+
+from accelerate_tpu.telemetry.waterfall import (
+    STAGES,
+    build_waterfalls,
+    load_router_requests,
+    summarize_waterfall,
+    waterfall_stages,
+)
+
+T0 = 1_700_000_000.0  # router-clock epoch for the hand-built records
+
+
+def router_rec(*, submit=T0, hops=None, ttft_ms=None, request_id="r1",
+               outcome="finished", replica="A"):
+    return {
+        "request_id": request_id, "submit_unix_s": submit,
+        "outcome": outcome, "replica": replica,
+        "ttft_ms": ttft_ms, "hops": hops or [],
+    }
+
+
+def hop(replica="A", *, place_start, connect, first_token=None,
+        placement_ms=None, error=None, backoff_before_ms=None):
+    h = {"replica": replica, "t_unix_s": round(place_start, 3),
+         "place_start_unix_s": place_start, "connect_unix_s": connect,
+         "placement_ms": (placement_ms if placement_ms is not None
+                          else round((connect - place_start) * 1e3, 3))}
+    if first_token is not None:
+        h["first_token_unix_s"] = first_token
+    if error is not None:
+        h["error"] = error
+    if backoff_before_ms is not None:
+        h["backoff_before_ms"] = backoff_before_ms
+    return h
+
+
+class TestStageMath:
+    def test_single_hop_stages_sum_to_client_ttft(self):
+        # submit at T0; placement 2ms; connect at +5ms; first token at
+        # +45ms; replica says: queue 10ms, ttft 30ms (incl. queue)
+        rec = router_rec(
+            hops=[hop(place_start=T0 + 0.003, connect=T0 + 0.005,
+                      first_token=T0 + 0.045)],
+            ttft_ms=45.0,
+        )
+        replica = {"request_id": "r1", "replica": "A",
+                   "queue_wait_ms": 10.0, "ttft_ms": 30.0}
+        row = waterfall_stages(rec, replica)
+        s = row["stages"]
+        assert row["joined"]
+        assert s["router_queue"] == pytest.approx(3.0, abs=0.01)
+        assert s["placement"] == pytest.approx(2.0, abs=0.01)
+        assert s["retry_backoff"] == 0.0
+        assert s["replica_queue"] == pytest.approx(10.0, abs=0.01)
+        assert s["prefill"] == pytest.approx(20.0, abs=0.01)
+        # transport = the residual of connect->first_token (40ms) after
+        # the replica's 30ms: the wire + framing cost
+        assert s["transport"] == pytest.approx(10.0, abs=0.01)
+        # THE contract: stages sum to the client-observed TTFT
+        assert sum(s.values()) == pytest.approx(45.0, abs=0.01)
+        assert row["e2e_ttft_ms"] == pytest.approx(45.0, abs=0.01)
+
+    def test_clock_skew_cannot_break_the_sum(self):
+        """The replica's absolute clock is minutes off (its
+        submit_unix_s would be useless); the stages still sum because
+        only the replica's DURATIONS are used — the epoch-anchor lesson
+        from the PR 11 trace merge, applied structurally."""
+        rec = router_rec(
+            hops=[hop(place_start=T0 + 0.001, connect=T0 + 0.002,
+                      first_token=T0 + 0.062)],
+            ttft_ms=62.0,
+        )
+        replica = {"request_id": "r1", "replica": "A",
+                   "submit_unix_s": T0 - 300.0,  # five minutes of skew
+                   "finish_unix_s": T0 - 299.0,
+                   "queue_wait_ms": 15.0, "ttft_ms": 40.0}
+        row = waterfall_stages(rec, replica)
+        assert sum(row["stages"].values()) == pytest.approx(62.0, abs=0.01)
+        assert row["stages"]["replica_queue"] == pytest.approx(15.0, abs=0.01)
+        assert row["stages"]["prefill"] == pytest.approx(25.0, abs=0.01)
+
+    def test_replica_durations_overrunning_the_hop_wall_are_scaled(self):
+        """Replica-reported durations longer than the hop's own
+        connect->first-token wall (coarse clocks, rounding) scale back
+        into it: the split shifts, the TOTAL never exceeds what the
+        client observed."""
+        rec = router_rec(
+            hops=[hop(place_start=T0 + 0.001, connect=T0 + 0.002,
+                      first_token=T0 + 0.012)],  # 10ms inside the hop
+            ttft_ms=12.0,
+        )
+        replica = {"request_id": "r1", "replica": "A",
+                   "queue_wait_ms": 12.0, "ttft_ms": 30.0}  # 30ms claimed
+        row = waterfall_stages(rec, replica)
+        s = row["stages"]
+        assert sum(s.values()) == pytest.approx(12.0, abs=0.01)
+        assert s["transport"] == pytest.approx(0.0, abs=0.01)
+        # the 12/18 queue/prefill proportion survives the scaling
+        assert s["replica_queue"] == pytest.approx(4.0, abs=0.01)
+        assert s["prefill"] == pytest.approx(6.0, abs=0.01)
+
+    def test_requeue_lands_in_retry_backoff(self):
+        # hop 0 fails (placement 1ms, then 8ms dying against A), 20ms
+        # backoff, hop 1 wins on B
+        h0 = hop("A", place_start=T0 + 0.002, connect=T0 + 0.003,
+                 error="ConnectionRefusedError: injected")
+        h1 = hop("B", place_start=T0 + 0.031, connect=T0 + 0.032,
+                 first_token=T0 + 0.052, backoff_before_ms=20.0)
+        rec = router_rec(hops=[h0, h1], ttft_ms=52.0, replica="B")
+        row = waterfall_stages(rec, None)
+        s = row["stages"]
+        assert row["replica"] == "B"
+        assert row["requeues"] == 1
+        assert s["router_queue"] == pytest.approx(2.0, abs=0.01)
+        assert s["placement"] == pytest.approx(2.0, abs=0.01)  # both hops
+        # everything between first placement and the winning connect
+        # that is not placement wall: the failed hop's dying wall (3ms ->
+        # 31ms, which includes the 20ms backoff) = 28ms
+        assert s["retry_backoff"] == pytest.approx(28.0, abs=0.01)
+        assert s["transport"] == pytest.approx(20.0, abs=0.01)  # unjoined
+        assert sum(s.values()) == pytest.approx(52.0, abs=0.01)
+
+    def test_attribution_names_the_slow_stage(self):
+        rec = router_rec(
+            hops=[hop(place_start=T0 + 0.001, connect=T0 + 0.002,
+                      first_token=T0 + 0.202)],
+            ttft_ms=202.0,
+        )
+        replica = {"request_id": "r1", "replica": "A",
+                   "queue_wait_ms": 5.0, "ttft_ms": 185.0}
+        row = waterfall_stages(rec, replica)
+        assert row["top_stage"] == "prefill"
+
+    def test_unfinished_or_unstamped_records_skip(self):
+        assert waterfall_stages(router_rec(hops=[]), None) is None
+        # uninstrumented hop (no stamps): no waterfall, no crash
+        bare = router_rec(hops=[{"replica": "A", "t_unix_s": T0}])
+        assert waterfall_stages(bare, None) is None
+        # shed before a first token: nothing to decompose
+        shed = router_rec(
+            hops=[hop(place_start=T0 + 0.001, connect=T0 + 0.002,
+                      error="ConnectionRefusedError: x")],
+            outcome="shed",
+        )
+        assert waterfall_stages(shed, None) is None
+
+
+class TestJoinAndAggregate:
+    def _burst(self, n=8, slow_from=4):
+        router_recs, replica_recs = [], []
+        for i in range(n):
+            slow = i >= slow_from
+            pf = 150.0 if slow else 20.0
+            ft = T0 + i + 0.004 + (pf + 5.0) / 1e3
+            replica = "B" if slow else "A"
+            router_recs.append(router_rec(
+                request_id=f"q{i}", submit=T0 + i, replica=replica,
+                hops=[hop(replica, place_start=T0 + i + 0.001,
+                          connect=T0 + i + 0.002, first_token=ft)],
+                ttft_ms=round((ft - (T0 + i)) * 1e3, 3),
+            ))
+            replica_recs.append({
+                "request_id": f"q{i}", "replica": replica,
+                "queue_wait_ms": 5.0, "ttft_ms": 5.0 + pf,
+            })
+        return router_recs, replica_recs
+
+    def test_join_matches_winning_replica(self):
+        router_recs, replica_recs = self._burst()
+        # a stale record from the OTHER replica under the same id must
+        # not win the join (re-queued request: one record per replica)
+        replica_recs.append({"request_id": "q0", "replica": "Z",
+                             "queue_wait_ms": 500.0, "ttft_ms": 900.0})
+        rows = build_waterfalls(router_recs, replica_recs)
+        assert len(rows) == 8
+        assert all(r["joined"] for r in rows)
+        q0 = next(r for r in rows if r["request_id"] == "q0")
+        assert q0["stages"]["replica_queue"] == pytest.approx(5.0, abs=0.01)
+
+    def test_aggregate_shares_sum_to_one_and_name_the_stage(self):
+        rows = build_waterfalls(*self._burst())
+        agg = summarize_waterfall(rows)
+        assert agg["requests"] == 8 and agg["joined"] == 8
+        shares = [d["share"] for d in agg["stages"].values()]
+        assert sum(shares) == pytest.approx(1.0, abs=0.01)
+        # half the burst hit the slow-prefill replica: prefill dominates
+        assert max(agg["stages"], key=lambda s: agg["stages"][s]["share"]) \
+            == "prefill"
+        assert agg["top_stages"].get("prefill", 0) >= 4
+        assert agg["e2e_ttft_p99_ms"] >= agg["e2e_ttft_p50_ms"]
+        assert set(agg["stages"]) <= set(STAGES)
+
+    def test_load_router_requests_round_trip(self, tmp_path):
+        recs, _ = self._burst(n=3)
+        path = tmp_path / "router-requests.jsonl"
+        with open(path, "w") as fh:
+            for rec in recs:
+                fh.write(json.dumps(rec) + "\n")
+            fh.write("torn {\n")  # mid-write death: skipped, not fatal
+        loaded = load_router_requests(str(tmp_path))
+        assert [r["request_id"] for r in loaded] == ["q0", "q1", "q2"]
+        rows = build_waterfalls(loaded, [])
+        assert len(rows) == 3 and not rows[0]["joined"]
