@@ -50,16 +50,19 @@ JAX_FREE_MODULES = (
     "accelerate_tpu.telemetry.fleet",
     "accelerate_tpu.telemetry.canary",
     "accelerate_tpu.telemetry.waterfall",
+    "accelerate_tpu.telemetry.scorecard",
     "accelerate_tpu.serving.pages",
     "accelerate_tpu.serving.scheduler",
     "accelerate_tpu.serving.faults",
     "accelerate_tpu.serving.router",
     "accelerate_tpu.serving.replica_server",
+    "accelerate_tpu.serving.loadgen",
     "accelerate_tpu.commands.trace",
     "accelerate_tpu.commands.report",
     "accelerate_tpu.commands.watch",
     "accelerate_tpu.commands.audit",
     "accelerate_tpu.commands.serve",
+    "accelerate_tpu.commands.loadtest",
     "accelerate_tpu.analysis",
     "accelerate_tpu.analysis.findings",
     "accelerate_tpu.analysis.hygiene",

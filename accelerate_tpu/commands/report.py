@@ -15,6 +15,7 @@ time go and why". It merges everything a session leaves behind —
     router-requests*.jsonl   router request log (waterfall's router half)
     canary-results.jsonl     synthetic canary probe outcomes
     audit.json               static-audit findings (`accelerate-tpu audit --out`)
+    loadtest-scorecard.json  SLO scorecard (`accelerate-tpu loadtest --out`)
 
 — into one explanation:
 
@@ -326,6 +327,17 @@ def load_audit(target: str) -> dict:
     return {}
 
 
+def load_loadtest_scorecard(target: str) -> dict:
+    """The SLO scorecard (``loadtest-scorecard.json`` written by
+    ``accelerate-tpu loadtest --out DIR``): attainment per tenant and
+    fleet-wide, goodput tokens/s-per-chip, the conservation ledger."""
+    if not _host_files(target, "loadtest-scorecard.json"):
+        return {}
+    from ..telemetry.scorecard import load_scorecard
+
+    return load_scorecard(target) or {}
+
+
 def load_report(target: str) -> dict:
     forensics = load_forensics(target)
     data = {
@@ -343,6 +355,7 @@ def load_report(target: str) -> dict:
         "waterfall": load_waterfall_summary(target),
         "canary": load_canary_summary(target),
         "audit": load_audit(target),
+        "loadtest": load_loadtest_scorecard(target),
     }
     req_files = _host_files(target, "requests-host*.jsonl")
     if req_files:
@@ -560,6 +573,14 @@ def format_report(data: dict) -> str:
                 f"{last.get('replica')} ({last.get('reason', '?')})"
             )
 
+    card = data.get("loadtest") or {}
+    if card:
+        from ..telemetry.scorecard import format_scorecard
+
+        lines.append("")
+        lines.append("loadtest scorecard:")
+        lines.extend("  " + ln for ln in format_scorecard(card))
+
     usage = data.get("usage") or {}
     tenants = usage.get("tenants") or {}
     if tenants:
@@ -686,6 +707,21 @@ def collect_diff_metrics(target: str) -> dict:
     canary = data.get("canary") or {}
     if isinstance(canary.get("pass_ratio"), (int, float)):
         out["canary_pass_ratio"] = float(canary["pass_ratio"])
+    # the replay-plane regression signals: fleet attainment/goodput plus
+    # per-tenant attainment — a tenant whose SLO slipped between rounds
+    # names itself even when the fleet number holds (mix shift)
+    card = data.get("loadtest") or {}
+    if card:
+        fleet = (card.get("fleet") or {})
+        for field in ("slo_attainment_frac", "goodput_tokens_per_s",
+                      "goodput_tokens_per_chip_s", "ttft_p99_ms",
+                      "itl_p99_ms"):
+            if isinstance(fleet.get(field), (int, float)):
+                out[f"loadtest/{field}"] = float(fleet[field])
+        for name, row in (card.get("tenants") or {}).items():
+            for field in ("slo_attainment_frac", "goodput_tokens_per_s"):
+                if isinstance(row.get(field), (int, float)):
+                    out[f"loadtest/{name}/{field}"] = float(row[field])
     out["recompiles_diagnosed"] = float(len(data.get("recompiles") or []))
     audit = data.get("audit") or {}
     if audit:
@@ -809,7 +845,7 @@ def report_command(args) -> int:
             or data["recompiles"] or data["first_compiles"] or data["steps"]
             or data["timeline"] or data["usage"] or data["alerts"]
             or data["fleet"] or data["waterfall"] or data["canary"]
-            or data["audit"]):
+            or data["audit"] or data["loadtest"]):
         print(f"no telemetry artifacts found under {args.target} — expected "
               "goodput-host*.json / costs-host*.json / forensics-host*.jsonl "
               "/ fleet.json / audit.json (see docs/telemetry.md)", file=sys.stderr)

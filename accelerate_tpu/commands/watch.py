@@ -309,6 +309,7 @@ def render_fleet_frame(collector, series_keys, width: int = 32,
                 f"[{_fmt_num(min(hist))} .. {_fmt_num(max(hist))}]"
             )
     lines.extend(_router_section(collector, width=width, span_s=span_s))
+    lines.extend(_cache_economics_section(collector))
     states = collector.alerts.states_snapshot()
     firing = sorted(n for n, st in states.items() if st["state"] == "firing")
     lines.append("")
@@ -332,6 +333,40 @@ def render_fleet_frame(collector, series_keys, width: int = 32,
 
 
 ROUTER_SERIES = ("router/inflight", "serving/queue_depth")
+
+
+def _cache_economics_section(collector) -> list:
+    """The prefix-cache economics block of a fleet frame — present only
+    when replicas export the ghost-cache gauges (``serving/ghost_*``,
+    serving/pages.py): actual hit ratio next to what 2x/4x/10x the
+    capacity WOULD buy, plus the reuse-after-evict distances that say
+    how far away the wasted re-prefills are. The gap between actual and
+    ghost ratios is the measured headroom a KV tier would capture."""
+    gauges = collector.fleet_gauges()
+    ghosts = {k: v for k, v in gauges.items()
+              if k.startswith("serving/ghost_")}
+    if not ghosts:
+        return []
+    actual = gauges.get("serving/prefix_hit_ratio")
+    would = " ".join(
+        f"{m}x={_fmt_num(ghosts.get(f'serving/ghost_hit_ratio_{m}x'))}"
+        for m in (2, 4, 10)
+        if ghosts.get(f"serving/ghost_hit_ratio_{m}x") is not None
+    )
+    lines = ["", (
+        "  cache economics: "
+        f"prefix hit ratio {_fmt_num(actual)}"
+        + (f" · at capacity {would}" if would else "")
+        + f" · reuse-after-evict {_fmt_num(ghosts.get('serving/ghost_reuses'))}"
+    )]
+    p50 = ghosts.get("serving/ghost_reuse_distance_p50")
+    p99 = ghosts.get("serving/ghost_reuse_distance_p99")
+    if p50 is not None or p99 is not None:
+        lines.append(
+            f"  reuse distance p50/p99: {_fmt_num(p50)}/{_fmt_num(p99)} "
+            "lookups (small = a modest capacity bump recovers them)"
+        )
+    return lines
 
 
 def _router_section(collector, width: int = 32, span_s: float = 600.0) -> list:

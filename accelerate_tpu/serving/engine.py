@@ -206,6 +206,7 @@ class ServingEngine:
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         prefix_cache: bool = True,
+        prefix_max_entries: Optional[int] = None,
         spec_draft_len: int = 0,
         drafter=None,
         scheduler=None,
@@ -302,8 +303,12 @@ class ServingEngine:
                 self.num_slots, self.pages_per_slot, parking=0
             )
             self._prefix = (
-                PrefixCache(self._allocator, self.page_size) if prefix_cache
-                else None
+                PrefixCache(
+                    self._allocator, self.page_size,
+                    **({"max_entries": int(prefix_max_entries)}
+                       if prefix_max_entries else {}),
+                )
+                if prefix_cache else None
             )
             self._drafter = drafter or (NGramDrafter() if self.spec_k else None)
             self._arena = init_paged_arena(
@@ -2253,6 +2258,12 @@ class ServingEngine:
                 out["serving/prefix_hit_tokens"] = self._prefix.hit_tokens
                 out["serving/prefix_entries"] = len(self._prefix.entries)
                 out["serving/prefill_chunks_skipped"] = self.prefill_chunks_skipped
+                if self._prefix.ghost is not None:
+                    # ghost-cache economics: the hit ratio the prefix
+                    # cache WOULD have at 2x/4x/10x entry capacity, plus
+                    # reuse-after-evict distances — the evidence base for
+                    # a host/disk KV tier (ROADMAP item 2)
+                    out.update(self._prefix.ghost.gauges())
         if self.spec_k:
             out["serving/spec_proposed"] = self.spec_proposed
             out["serving/spec_accepted"] = self.spec_accepted

@@ -163,6 +163,155 @@ class PrefixEntry:
     last_used: int = 0
 
 
+class _GhostShadow:
+    """Key-level LRU twin of a :class:`PrefixCache` at a scaled
+    ``max_entries`` — entries are ``key -> [token_len, last_used]``, no
+    pages, no allocator. Lookup/insert/evict follow the real cache's
+    semantics exactly (longest-first probe, recency on committed hits and
+    insert-touch, evict min ``last_used`` past capacity), so its hit count
+    equals a brute-force ``PrefixCache(max_entries=N*base)`` replaying the
+    same trace — the oracle tests/test_loadgen.py asserts against."""
+
+    __slots__ = ("max_entries", "entries", "_clock", "hits")
+
+    def __init__(self, max_entries: int):
+        self.max_entries = int(max_entries)
+        self.entries: dict = {}  # key bytes -> [token_len, last_used]
+        self._clock = 0
+        self.hits = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def lookup(self, n: int, dig) -> int:
+        """Probe like ``PrefixCache.peek`` (longest cached length
+        ``<= n`` whose prefix digest matches), self-committing the hit:
+        the simulation has no engine to decline it."""
+        for length in sorted({e[0] for e in self.entries.values()},
+                             reverse=True):
+            if length > n:
+                continue
+            e = self.entries.get(dig(length))
+            if e is not None and e[0] == length:
+                self.hits += 1
+                e[1] = self._tick()
+                return length
+        return 0
+
+    def insert(self, keyed_lengths):
+        for length, key in keyed_lengths:
+            e = self.entries.get(key)
+            if e is not None:
+                e[1] = self._tick()
+                continue
+            self.entries[key] = [length, self._tick()]
+        while len(self.entries) > self.max_entries:
+            victim = min(self.entries, key=lambda k: self.entries[k][1])
+            del self.entries[victim]
+
+
+class GhostCache:
+    """Ghost-cache economics telemetry for a :class:`PrefixCache`: what
+    would larger capacities recover?
+
+    Two instruments, both keys-only (no pages, no KV bytes — the whole
+    point is measuring the value of storage that does NOT exist yet):
+
+    - **capacity shadows**: one :class:`_GhostShadow` LRU simulation per
+      multiple of the real cache's ``max_entries`` (default 2x/4x/10x),
+      fed the same lookup/insert stream. ``hit_ratio(m)`` is the hit
+      ratio the cache WOULD have at ``m x`` capacity — compare against
+      ``serving/prefix_hit_ratio``; the gap is the reuse an entry-LRU
+      host/disk tier (ROADMAP item 2) would serve.
+    - **reuse-after-evict distances**: every key the real cache evicts is
+      remembered (bounded, eviction-ordered); when a later ``insert``
+      re-registers an evicted key — a re-prefill of KV the cache already
+      held, the exact waste a tier absorbs — the distance in lookups
+      since eviction is recorded.
+
+    Shadows only model capacity-driven (``max_entries``) eviction: a
+    simulated larger cache is assumed to keep its entries' KV in a tier,
+    so the real arena's page pressure does not apply to it.
+    """
+
+    def __init__(self, base_entries: int, multiples=(2, 4, 10),
+                 max_distances: int = 4096):
+        self.multiples = tuple(sorted({int(m) for m in multiples}))
+        if not self.multiples or self.multiples[0] < 1:
+            raise ValueError(f"bad ghost multiples {multiples!r}")
+        self.shadows = {
+            m: _GhostShadow(m * int(base_entries)) for m in self.multiples
+        }
+        self.lookups = 0
+        self.reuses = 0
+        self._evicted: dict = {}  # key -> lookup count at eviction
+        self._evicted_cap = max(self.multiples) * int(base_entries)
+        self._distances: list = []
+        self._max_distances = int(max_distances)
+
+    def observe_lookup(self, prompt: np.ndarray, limit: Optional[int] = None):
+        self.lookups += 1
+        n = int(prompt.size if limit is None else min(prompt.size, limit))
+        memo: dict = {}
+
+        def dig(length):
+            d = memo.get(length)
+            if d is None:
+                d = memo[length] = _digest(prompt[:length])
+            return d
+
+        for shadow in self.shadows.values():
+            shadow.lookup(n, dig)
+
+    def observe_insert(self, keyed_lengths):
+        """``keyed_lengths``: the ``(length, key)`` pairs the real
+        insert computed — shared so the prompt hashes exactly once."""
+        for _, key in keyed_lengths:
+            at = self._evicted.pop(key, None)
+            if at is not None:
+                self.reuses += 1
+                self._distances.append(self.lookups - at)
+                if len(self._distances) > self._max_distances:
+                    del self._distances[: self._max_distances // 2]
+        for shadow in self.shadows.values():
+            shadow.insert(keyed_lengths)
+
+    def observe_evict(self, key: bytes):
+        self._evicted[key] = self.lookups
+        while len(self._evicted) > self._evicted_cap:
+            del self._evicted[next(iter(self._evicted))]
+
+    def hit_ratio(self, multiple: int) -> float:
+        shadow = self.shadows[int(multiple)]
+        return shadow.hits / self.lookups if self.lookups else 0.0
+
+    def reuse_distance_quantile(self, q: float) -> float:
+        if not self._distances:
+            return 0.0
+        xs = sorted(self._distances)
+        idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+        return float(xs[idx])
+
+    def gauges(self) -> dict:
+        """``serving/ghost_*`` gauge fragment merged into
+        ``ServingEngine.metrics()`` (and so into rollup -> Prometheus
+        exposition -> fleet merge; the 2x/4x/10x ratios average across
+        replicas, reuse distances take the fleet-worst)."""
+        out = {}
+        for m in self.multiples:
+            out[f"serving/ghost_hit_ratio_{m}x"] = self.hit_ratio(m)
+        out["serving/ghost_reuses"] = self.reuses
+        if self._distances:
+            out["serving/ghost_reuse_distance_p50"] = (
+                self.reuse_distance_quantile(0.5)
+            )
+            out["serving/ghost_reuse_distance_p99"] = (
+                self.reuse_distance_quantile(0.99)
+            )
+        return out
+
+
 class PrefixCache:
     """Prompt-prefix -> shared-pages map, keyed by token-content hash.
 
@@ -177,7 +326,7 @@ class PrefixCache:
     """
 
     def __init__(self, allocator: PageAllocator, page_size: int,
-                 max_entries: int = 512):
+                 max_entries: int = 512, ghost_multiples=(2, 4, 10)):
         self.allocator = allocator
         self.page_size = int(page_size)
         self.max_entries = int(max_entries)
@@ -186,6 +335,12 @@ class PrefixCache:
         self.lookups = 0
         self.hits = 0
         self.hit_tokens = 0
+        # ghost-cache economics telemetry (keys only — a few dict ops per
+        # lookup/insert; pass ghost_multiples=None/() to disable)
+        self.ghost = (
+            GhostCache(self.max_entries, ghost_multiples)
+            if ghost_multiples else None
+        )
 
     def _tick(self) -> int:
         self._clock += 1
@@ -204,6 +359,8 @@ class PrefixCache:
         or would cost more prefill dispatches than a cold admission, and
         the hit-ratio gauges must reflect the final decision)."""
         self.lookups += 1
+        if self.ghost is not None:
+            self.ghost.observe_lookup(prompt, limit)
         return self.peek(prompt, limit)
 
     def peek(self, prompt: np.ndarray, limit: Optional[int] = None):
@@ -243,9 +400,9 @@ class PrefixCache:
         lengths = list(range(ps, n + 1, ps))
         if n % ps:
             lengths.append(n)  # partial-page tail: the COW-fork case
+        keyed = [(length, _digest(prompt[:length])) for length in lengths]
         created = 0
-        for length in lengths:
-            key = _digest(prompt[:length])
+        for length, key in keyed:
             hit = self.entries.get(key)
             if hit is not None:
                 hit.last_used = self._tick()
@@ -259,6 +416,8 @@ class PrefixCache:
                 self.allocator.retain(p)
             self.entries[key] = entry
             created += 1
+        if self.ghost is not None:
+            self.ghost.observe_insert(keyed)
         while len(self.entries) > self.max_entries and self.evict_lru():
             pass
         return created
@@ -273,6 +432,8 @@ class PrefixCache:
         entry = self.entries.pop(key)
         for p in entry.pages:
             self.allocator.release(p)
+        if self.ghost is not None:
+            self.ghost.observe_evict(key)
         return True
 
     def clear(self):

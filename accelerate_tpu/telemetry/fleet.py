@@ -319,6 +319,7 @@ _COUNTER_KEYS = frozenset({
     "router/requeues", "router/requests_requeued",
     "router/requeue_success", "router/kv_migrations",
     "canary/probes_sent", "canary/probes_passed", "canary/probes_failed",
+    "serving/ghost_reuses",
 })
 # per-member counter families under a dynamic tail (tenant ids, replica
 # names, shed reasons): counters by prefix. No trailing slash on the
@@ -327,7 +328,11 @@ _COUNTER_KEYS = frozenset({
 # full path ("router/failures/A"); both must land on SUM_COUNTER.
 _COUNTER_PREFIXES = ("usage/", "router/failures", "router/shed")
 _MEAN_SUFFIXES = ("_frac", "_ratio", "_pct", "occupancy", "_rate",
-                  "load_score", "itl_budget", "kv_cache_bits")
+                  "load_score", "itl_budget", "kv_cache_bits",
+                  # ghost-cache simulated hit ratios (a "_ratio" family,
+                  # but the capacity-multiple tail hides the suffix)
+                  "ghost_hit_ratio_2x", "ghost_hit_ratio_4x",
+                  "ghost_hit_ratio_10x")
 # last_pass_unix_s: the canary freshness watermark is "when did ANY
 # probe last verify the service" — fleet-newest; e2e_ttft_ms gauges are
 # last-probe latencies — fleet-worst

@@ -236,6 +236,31 @@ class UsageAccountant:
                 }
             return out
 
+    def rates(self, seconds: float, now: Optional[float] = None,
+              eps_span_s: float = 1e-6) -> dict:
+        """Per-tenant windowed rates derived from :meth:`window`:
+        ``{tenant: {prefill_tokens_per_s, decode_tokens_per_s,
+        pages_mean, span_s}}``. The first window after start (or a
+        same-instant query) has ``span_s`` 0 — rates report **0** there
+        instead of raising or returning inf (the zero-span guard the
+        SLO scorecard shares; tests/test_loadgen.py locks it)."""
+        out = {}
+        for name, w in self.window(seconds, now).items():
+            span = w["span_s"]
+            guard = span > eps_span_s
+            out[name] = {
+                "prefill_tokens_per_s": (
+                    w["prefill_tokens"] / span if guard else 0.0
+                ),
+                "decode_tokens_per_s": (
+                    w["decode_tokens"] / span if guard else 0.0
+                ),
+                # page_seconds/span = mean pages held over the window
+                "pages_mean": w["page_seconds"] / span if guard else 0.0,
+                "span_s": span,
+            }
+        return out
+
     def rollup_keys(self) -> dict:
         """Flat ``usage/<tenant>/<field>`` gauges for the session rollup
         (cardinality bounded by ``max_tenants`` folding)."""

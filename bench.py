@@ -1590,6 +1590,78 @@ def _router_failover_bench(cfg, prompt_len, *, page_size=16, num_slots=2,
             s.close()
 
 
+def _loadtest_bench(cfg, *, page_size=16, num_slots=2):
+    """Replay the canonical workload spec (tests/workload_canonical.json)
+    against a fresh engine and grade it — the SLO-scorecard rows:
+
+    - ``loadtest_slo_attainment`` — fraction of finished requests meeting
+      the spec's TTFT/ITL targets (asserted conserved first: every
+      offered request reached a definite outcome);
+    - ``loadtest_goodput_tokens_per_chip`` — finished tokens/s per chip;
+    - ``ghost_hit_ratio_4x`` — the simulated prefix-cache hit ratio at
+      4x capacity from the same drill (cache-economics telemetry: the
+      gap vs ``serving/prefix_hit_ratio`` is the KV-tiering headroom).
+
+    The spec is seeded and closed-loop, so the schedule — and with it
+    the ghost ratio — is deterministic; only the timing rows breathe.
+    """
+    import dataclasses
+
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving import loadgen
+    from accelerate_tpu.serving.engine import ServingEngine
+    from accelerate_tpu.telemetry import scorecard as sc
+
+    spec = loadgen.WorkloadSpec.load(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "workload_canonical.json",
+    ))
+    need = spec.prompt_cap + 16  # prompt cap + output + spec margin
+    cap = -(-min(cfg.max_seq_len, need) // page_size) * page_size
+    cfg = dataclasses.replace(cfg, max_cache_len=cap)
+    model_def = DecoderLM(cfg)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(0), batch_size=1, seq_len=spec.prompt_cap
+    )
+    params, _ = unbox_params(variables["params"])
+    engine = ServingEngine(
+        model_def, params, num_slots=num_slots, max_cache_len=cap,
+        prefill_chunks=(page_size, 2 * page_size), page_size=page_size,
+        prefix_max_entries=6,  # small on purpose: the ghost shadows need
+                               # real evictions to have economics to report
+    )
+    engine.telemetry = None
+    engine.warmup()
+    engine.mark_steady()
+    result = loadgen.run(spec, engine, time_scale=0.0, timeout_s=120)
+    card = sc.build_scorecard(result, chips=max(1, jax.device_count()))
+    counts = card["counts"]
+    assert card["conserved"] and counts["in_flight"] == 0, (
+        f"canonical drill did not conserve/drain: {counts}"
+    )
+    assert engine.admission_recompiles == 0, (
+        "the canonical workload recompiled post-steady"
+    )
+    metrics = engine.metrics()
+    return {
+        "loadtest_slo_attainment": round(
+            card["fleet"]["slo_attainment_frac"], 4
+        ),
+        "loadtest_goodput_tokens_per_chip": (
+            card["fleet"]["goodput_tokens_per_chip_s"]
+        ),
+        "loadtest_finished": counts["finished"],
+        "loadtest_schedule_digest": result.digest,
+        "ghost_hit_ratio_4x": round(
+            metrics.get("serving/ghost_hit_ratio_4x", 0.0), 4
+        ),
+        "prefix_hit_ratio": round(
+            metrics.get("serving/prefix_hit_ratio", 0.0), 4
+        ),
+    }
+
+
 def _pipeline_mem_worker():
     """Compiled temp-memory (stash + belts) for gpipe-under-AD vs the manual
     1F1B schedule at M=4S, on the 8-device CPU sim (the schedule's win is a
@@ -1932,6 +2004,14 @@ def main():
         extra["canary_pass_ratio"] = (
             extra["router_failover"]["canary_pass_ratio"]
         )
+        # workload-replay rows: the canonical spec graded by the SLO
+        # scorecard + the ghost-cache economics gauge (report --diff
+        # grades attainment/goodput/ghost-ratio drift between rounds)
+        extra["loadtest"] = _loadtest_bench(ttft_cfg, page_size=64)
+        for key in ("loadtest_slo_attainment",
+                    "loadtest_goodput_tokens_per_chip",
+                    "ghost_hit_ratio_4x"):
+            extra[key] = extra["loadtest"][key]
         # the transfer_flush noise rows (median-of-rounds + spread; the
         # best-attempt phase breakdown above keeps the old shape)
         for v in ("bf16", "int8", "int4"):
@@ -2057,6 +2137,16 @@ def main():
         extra["canary_pass_ratio"] = (
             extra["router_failover"]["canary_pass_ratio"]
         )
+        # workload-replay rows, CPU-sized (same canonical spec + digest
+        # as the TPU branch — the schedule is seed-determined, so the
+        # attainment/ghost rows diff cleanly across backends and rounds)
+        extra["loadtest"] = _loadtest_bench(
+            DecoderConfig.tiny(max_seq_len=256), page_size=16,
+        )
+        for key in ("loadtest_slo_attainment",
+                    "loadtest_goodput_tokens_per_chip",
+                    "ghost_hit_ratio_4x"):
+            extra[key] = extra["loadtest"][key]
 
     # static-audit regression rows (both branches; post-warmup pass)
     extra.update(_audit_rows())

@@ -25,7 +25,20 @@ import jax
 
 from accelerate_tpu.models import DecoderConfig, DecoderLM
 from accelerate_tpu.parallel.sharding import unbox_params
-from accelerate_tpu.serving import FaultInjector, SchedulerConfig, ServingEngine
+from accelerate_tpu.serving import SchedulerConfig, ServingEngine
+from accelerate_tpu.serving import loadgen
+
+# the alert drill's tenant burst, as a replayable workload: 3 "batch"
+# requests fired as one storm (paired_drill gives this spec and the
+# FaultInjector the SAME seed, so drill traffic and injected faults
+# reproduce as a unit)
+STORM_SPEC = loadgen.WorkloadSpec(
+    name="ops-storm", mode="open", num_requests=3, vocab_size=256,
+    prompt_cap=12,
+    tenants=[{"name": "batch", "priority": 0,
+              "prompt_len": {"fixed": 12},
+              "max_new_tokens": {"fixed": 3}}],
+)
 from accelerate_tpu.telemetry import TelemetryConfig, TelemetrySession, current_session
 from accelerate_tpu.telemetry.alerts import FIRING, OK, default_ruleset
 from accelerate_tpu.telemetry.exporter import prometheus_text
@@ -77,7 +90,10 @@ class TestAlertDrill:
             itl_slow_s=12.0, itl_factor=2.0, itl_for_s=2.0,
         )
         session = _session(tmp_path, alert_rules=rules)
-        faults = FaultInjector(seed=0)
+        # one seed pair: the storm's traffic and its fault injector
+        # reproduce together (the replay-plane contract — no more
+        # hand-rolled submit loops in the drill)
+        storm_spec, faults = loadgen.paired_drill(0, STORM_SPEC)
         engine = _engine(model, params, session, faults=faults)
         try:
             engine.warmup()
@@ -109,11 +125,9 @@ class TestAlertDrill:
                 every=1, delay_s=2.5 * slo_ms / 1e3,
                 start=engine.step_count, stop=engine.step_count + 10,
             )
-            faults.storm(at_step=engine.step_count + 1, fire=lambda eng: storm_reqs.extend(
-                eng.submit(prompts[3], max_new_tokens=3, seed=50 + i,
-                           tenant="batch", priority=0)
-                for i in range(3)
-            ))
+            faults.storm(at_step=engine.step_count + 1,
+                         fire=lambda eng: storm_reqs.extend(
+                             loadgen.submit_burst(eng, storm_spec)))
             saw_pending = False
             dumps_before = session.flight.dump_count
             for _ in range(8):
