@@ -52,6 +52,7 @@ JAX_FREE_MODULES = (
     "accelerate_tpu.telemetry.waterfall",
     "accelerate_tpu.telemetry.scorecard",
     "accelerate_tpu.serving.pages",
+    "accelerate_tpu.serving.tiers",
     "accelerate_tpu.serving.scheduler",
     "accelerate_tpu.serving.faults",
     "accelerate_tpu.serving.router",

@@ -722,6 +722,12 @@ def collect_diff_metrics(target: str) -> dict:
             for field in ("slo_attainment_frac", "goodput_tokens_per_s"):
                 if isinstance(row.get(field), (int, float)):
                     out[f"loadtest/{name}/{field}"] = float(row[field])
+        # KV-tiering restore rows (only present when the joined server
+        # records saw restores): a restore-latency regression between
+        # rounds names the tier plumbing, not the model
+        for field in ("kv_restores", "kv_restore_ms_p50"):
+            if isinstance(card.get(field), (int, float)):
+                out[f"loadtest/{field}"] = float(card[field])
     out["recompiles_diagnosed"] = float(len(data.get("recompiles") or []))
     audit = data.get("audit") or {}
     if audit:

@@ -103,6 +103,22 @@ def register(subparsers):
                               "cache, no KV handoff)")
     replica.add_argument("--kv-cache-dtype", default=None,
                          choices=["bf16", "int8", "int4"])
+    replica.add_argument("--kv-host-entries", type=int, default=0,
+                         help="host-RAM KV tier capacity in prefix entries "
+                              "(0 = tiering off; evictions drop as before)")
+    replica.add_argument("--kv-disk-entries", type=int, default=0,
+                         help="disk KV tier capacity in prefix entries "
+                              "(needs --kv-disk-dir)")
+    replica.add_argument("--kv-disk-dir", default=None, metavar="DIR",
+                         help="directory for demoted KV blobs (durable "
+                              "across restarts; torn/corrupt blobs are "
+                              "rejected and deleted)")
+    replica.add_argument("--kv-peers", action="append", default=[],
+                         metavar="[NAME=]URL",
+                         help="peer replica base URL for the fleet KV tier "
+                              "(repeatable): a local miss pulls a warm "
+                              "prefix over /v1/kv/export after checking "
+                              "the peer's /v1/kv/directory")
     replica.add_argument("--temperature", type=float, default=0.0)
     replica.add_argument("--top-k", type=int, default=None)
     replica.add_argument("--steps-per-call", type=int, default=1)
@@ -208,6 +224,19 @@ def build_replica_engine(args):
         int(c) for c in str(args.prefill_chunks).split(",") if c.strip()
     )
     page_size = int(args.page_size) or None
+    kv_tiers = None
+    host_entries = int(getattr(args, "kv_host_entries", 0) or 0)
+    disk_entries = int(getattr(args, "kv_disk_entries", 0) or 0)
+    peers = _parse_replica_flags(getattr(args, "kv_peers", []) or [])
+    if page_size and (host_entries or disk_entries or peers):
+        from ..serving.tiers import TierConfig
+
+        kv_tiers = TierConfig(
+            host_entries=max(host_entries, 1 if (disk_entries or peers) else 0),
+            disk_entries=disk_entries,
+            disk_dir=getattr(args, "kv_disk_dir", None),
+            peers=tuple(peers),
+        )
     return ServingEngine(
         model, params,
         num_slots=int(args.num_slots),
@@ -219,6 +248,7 @@ def build_replica_engine(args):
         steps_per_call=int(args.steps_per_call),
         kv_cache_dtype=args.kv_cache_dtype,
         replica=args.name,
+        kv_tiers=kv_tiers,
     )
 
 

@@ -366,6 +366,23 @@ def _cache_economics_section(collector) -> list:
             f"  reuse distance p50/p99: {_fmt_num(p50)}/{_fmt_num(p99)} "
             "lookups (small = a modest capacity bump recovers them)"
         )
+    # per-tier hit breakdown (hierarchical KV tiering, serving/tiers.py):
+    # where hits actually land once demote-on-evict is on — the ghost
+    # ratios above now measure headroom BEYOND the total tier capacity
+    tiers = " ".join(
+        f"{t}={_fmt_num(gauges.get(f'serving/kv_tier_hit_ratio_{t}'))}"
+        for t in ("hbm", "host", "disk", "peer")
+        if gauges.get(f"serving/kv_tier_hit_ratio_{t}") is not None
+    )
+    if tiers:
+        restores = gauges.get("serving/kv_restores")
+        aborted = gauges.get("serving/kv_restores_aborted")
+        lines.append(
+            f"  tier hits: {tiers}"
+            + (f" · restores {_fmt_num(restores)}" if restores is not None
+               else "")
+            + (f" (aborted {_fmt_num(aborted)})" if aborted else "")
+        )
     return lines
 
 

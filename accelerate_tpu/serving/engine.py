@@ -52,7 +52,7 @@ from .pages import (
     PrefixCache,
     dense_slot_view,
     fork_page,
-    gather_pages,
+    gather_page,
     init_paged_arena,
     install_page,
     kv_cache_bits,
@@ -60,6 +60,7 @@ from .pages import (
     set_table_entry,
     set_table_row,
 )
+from .tiers import TierConfig, TieredStore, TierEntry, entry_nbytes
 from .scheduler import (
     SHED_DRAINING,
     SHED_PAGE_EXHAUSTED,
@@ -129,6 +130,13 @@ class Request:
     pages_allocated: int = 0   # fresh pages this request consumed (forks incl.)
     spec_proposed: int = 0     # draft tokens proposed for this request
     spec_accepted: int = 0     # draft tokens accepted by verify steps
+    # hierarchical KV tiering (serving/tiers.py): which tier the prefix
+    # was restored from (None = HBM hit or cold), how long the restore
+    # took, and how many pages it installed — the request-record hop the
+    # latency waterfall's kv_restore stage attributes
+    kv_restore_tier: Optional[str] = None
+    kv_restore_ms: float = 0.0
+    kv_restore_pages: int = 0
 
     def result(self) -> np.ndarray:
         """[prompt + generated] token ids (the ``generate()`` contract)."""
@@ -213,6 +221,7 @@ class ServingEngine:
         faults=None,
         kv_cache_dtype: Optional[str] = None,
         replica: Optional[str] = None,
+        kv_tiers=None,
     ):
         from ..utils.compile_cache import (
             compile_event_counters,
@@ -302,11 +311,39 @@ class ServingEngine:
             self._tables_host = PagedTables(
                 self.num_slots, self.pages_per_slot, parking=0
             )
+            # hierarchical KV tiering (serving/tiers.py): demote-on-evict
+            # host/disk/peer store under the prefix cache. A TierConfig
+            # builds the store here (wired to the usage byte-seconds hook
+            # and this replica's identity); a prebuilt TieredStore is
+            # taken as-is; None = tiering off (evictions drop, as before)
+            if isinstance(kv_tiers, TierConfig):
+                self._tiers = TieredStore(
+                    kv_tiers, page_size=self.page_size,
+                    kv_cache_dtype=self.kv_cache_dtype,
+                    replica=replica, on_bytes=self._note_tier_bytes,
+                )
+            else:
+                self._tiers = kv_tiers
+                if self._tiers is not None and self._tiers.on_bytes is None:
+                    self._tiers.on_bytes = self._note_tier_bytes
+            tier_entries = (
+                self._tiers.config.entry_capacity() if self._tiers else 0
+            )
+            prefix_entries = (
+                int(prefix_max_entries) if prefix_max_entries else 512
+            )
             self._prefix = (
                 PrefixCache(
                     self._allocator, self.page_size,
-                    **({"max_entries": int(prefix_max_entries)}
-                       if prefix_max_entries else {}),
+                    max_entries=prefix_entries,
+                    # tier-aware ghost shadows: headroom beyond the new
+                    # TOTAL (HBM+host+disk) capacity
+                    ghost_base_entries=(
+                        prefix_entries + tier_entries if tier_entries else None
+                    ),
+                    on_evict=(
+                        self._demote_entry if self._tiers is not None else None
+                    ),
                 )
                 if prefix_cache else None
             )
@@ -355,6 +392,11 @@ class ServingEngine:
             self._install_page = jax.jit(
                 install_page, donate_argnums=(0,) if self._donate else ()
             )
+            # demote-on-evict read: install_page's mirror, traced src —
+            # one compiled program gathers any page, so post-steady
+            # demotions never recompile (gather_pages' per-call id list
+            # would compile per distinct page count)
+            self._gather_page = jax.jit(gather_page)
             self._verify_step = (
                 jax.jit(self._build_verify_core(),
                         donate_argnums=(1, 2, 4, 6) if self._donate else ())
@@ -363,6 +405,7 @@ class ServingEngine:
         else:
             self._paged_def = None
             self._prefix = None
+            self._tiers = None
             self._drafter = None
             self._verify_step = None
             self._kernel_costed = False
@@ -371,6 +414,17 @@ class ServingEngine:
         self.page_forks = 0
         self.kv_pages_exported = 0
         self.kv_pages_imported = 0
+        # hierarchical-tiering accounting: committed admission hits per
+        # tier (hbm = a plain prefix hit with no restore behind it), and
+        # the restore batch counters behind kv_restore_overlap_frac
+        self.kv_tier_hits = {"hbm": 0, "host": 0, "disk": 0, "peer": 0}
+        self.kv_restore_batches = 0
+        self.kv_restore_batches_overlapped = 0
+        self.kv_restores = 0
+        self.kv_restores_aborted = 0
+        self._restore = None  # live restore state (see _plan_restore)
+        self._restored_tier = None  # transient: which tier fed the
+        self._kv_paths = None       # admission being planned right now
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.prefill_chunks_skipped = 0
@@ -702,6 +756,10 @@ class ServingEngine:
             self._arena = self._install_page(
                 self._arena, self._page_slice_tree(), 0
             )
+            # ... and its mirror, the demote-on-evict page gather (reads
+            # the parking page; nothing observable), so a post-steady
+            # eviction can demote into the host tier with zero recompiles
+            jax.device_get(self._gather_page(self._arena, 0))
             if self._kernel_costed and costs is not None:
                 # seed the kernel's dynamic roofline row at warmup so a
                 # rollup/report taken before traffic already lists the
@@ -1221,6 +1279,13 @@ class ServingEngine:
         prefilled pages are released, the request terminates."""
         req, slot = self._admitting[0], self._admitting[1]
         self._admitting = None
+        if self._restore is not None:
+            # a mid-restore abort: the target pages were allocated but
+            # never published — release them here or they leak
+            for p in self._restore["pages"]:
+                self._allocator.release(p)
+            self._restore = None
+            self.kv_restores_aborted += 1
         if self.page_size:
             self._release_slot_pages(slot, tenant=req.tenant)
         self._free.append(slot)
@@ -1318,7 +1383,9 @@ class ServingEngine:
                 # page out THROUGH the prefix cache: the entries hold the
                 # refs, so re-admission maps them back as cache hits (and
                 # LRU eviction can still reclaim them under real pressure)
-                self._prefix.insert(replay, self._tables_host.rows[slot])
+                self._prefix.insert(
+                    replay, self._tables_host.rows[slot], tenant=req.tenant
+                )
             self._release_slot_pages(slot, tenant=req.tenant)
         self._free.append(slot)
         req.slot = None
@@ -1513,6 +1580,11 @@ class ServingEngine:
                 usage.note_pages(req.tenant, n_map)
         if usage is not None and hit_len:
             usage.note_prefix_hit(req.tenant, hit_len)
+        if hit_len:
+            # tier attribution: a hit right after a restore belongs to
+            # the tier that supplied the pages; every other committed
+            # hit was HBM-resident all along
+            self.kv_tier_hits[self._restored_tier or "hbm"] += 1
         req.prefix_hit = hit_len
         if hit_len:
             # prefill chunks the cached prefix made unnecessary (TTFT
@@ -1536,7 +1608,9 @@ class ServingEngine:
         n_pages = -(-req.prompt.size // self.page_size)
         if n_pages > self._tables_host.alloc_count[slot]:
             return  # cannot happen post-prefill; guard for safety
-        self._prefix.insert(req.prompt, self._tables_host.rows[slot])
+        self._prefix.insert(
+            req.prompt, self._tables_host.rows[slot], tenant=req.tenant
+        )
 
     def _release_slot_pages(self, slot: int, tenant: Optional[str] = None):
         """Eviction: drop the slot's page references (pages still retained
@@ -1555,6 +1629,172 @@ class ServingEngine:
         self._page_tables = self._set_row(
             self._page_tables, slot, jnp.asarray(th.rows[slot])
         )
+
+    # -- hierarchical KV tiering (HBM -> host -> disk -> peers) -------------
+
+    def _note_tier_bytes(self, tenant: str, tier: str, delta: int):
+        """TieredStore byte-movement hook -> the usage accountant's
+        per-tenant tier byte-seconds meter (same symmetric contract as
+        note_pages: every + has a matching -, held bytes drain to 0)."""
+        if getattr(self, "telemetry", None) is None:
+            # the disk-tier scan runs during __init__, before the
+            # telemetry attribute lands — nothing to meter yet
+            return
+        usage = self._usage()
+        if usage is not None:
+            usage.note_tier_bytes(tenant, tier, delta)
+
+    def _demote_entry(self, entry):
+        """PrefixCache ``on_evict`` hook: gather the victim entry's
+        pages off the arena (per-page through the warmup-compiled
+        gather program — zero recompiles post-steady) and offer them to
+        the host tier. Skips entries a tier already covers (a longer
+        demoted entry serves every shorter aligned prefix), so the
+        per-length cache entries never store the same pages twice."""
+        tiers = self._tiers
+        if tiers is None or entry.tokens is None or tiers.covers(entry.key):
+            return
+        if self._kv_paths is None:
+            self._kv_paths = [p for p, _ in self._kv_leaf_specs()]
+        from .pages import _page_axis as _pa
+
+        per_page = [
+            jax.device_get(self._gather_page(self._arena, int(p)))
+            for p in entry.pages
+        ]
+        arrays = [
+            np.concatenate([pp[i] for pp in per_page], axis=_pa(per_page[0][i]))
+            for i in range(len(per_page[0]))
+        ]
+        tokens = np.asarray(entry.tokens, np.int32)
+        tiers.put(TierEntry(
+            key=entry.key, token_len=entry.token_len, tokens=tokens,
+            n_pages=len(entry.pages), arrays=arrays, paths=self._kv_paths,
+            nbytes=entry_nbytes(arrays, tokens), tenant=entry.tenant,
+        ))
+
+    def _plan_restore(self, req: Request, seq: np.ndarray) -> Optional[dict]:
+        """Probe the lower tiers for a prefix of ``seq`` longer than the
+        HBM cache's own best and, on a hit, allocate its target pages.
+        Returns the restore state ``_advance_restore`` drives, or None
+        (cold admission). Page pressure aborts the restore — a restore
+        is an optimization, never worth shedding or preempting live
+        work for — and the admission falls back to a cold prefill."""
+        tiers = self._tiers
+        if tiers is None or self._prefix is None or seq.size < 2:
+            return None
+        limit = seq.size - 1
+        hbm_len, _ = self._prefix.peek(seq, limit)
+        hit = tiers.probe(seq, limit, min_len=hbm_len)
+        if hit is None:
+            return None
+        if hit["tier"] == "peer":
+            try:
+                tokens, token_len, _, arrays = self._handoff_arrays(
+                    hit["handoff"]
+                )
+            except ValueError:
+                self.kv_restores_aborted += 1
+                return None
+        else:
+            tokens, arrays = hit["tokens"], hit["arrays"]
+            token_len = hit["token_len"]
+        # the same commit heuristics _paged_admit_plan applies to an HBM
+        # hit, applied BEFORE paying for the restore: a hit the admit
+        # plan would shrink or decline must not install pages first
+        cold_chunks = len(self._plan_chunks(seq.size))
+        hit_len = int(token_len)
+        while hit_len and (
+            hit_len + self._plan_cover(seq.size - hit_len) > self.max_cache_len
+        ):
+            hit_len = max(0, hit_len - self.page_size)
+        if hit_len and (
+            len(self._plan_chunks(seq.size - hit_len)) > cold_chunks
+        ):
+            hit_len = 0
+        if hit_len <= hbm_len:
+            return None
+        n_pages = -(-hit_len // self.page_size)
+        pages = []
+        try:
+            for _ in range(n_pages):
+                pages.append(self._alloc_page())
+        except PagePressure:
+            for p in pages:
+                self._allocator.release(p)
+            self.kv_restores_aborted += 1
+            return None
+        return {
+            "tier": hit["tier"],
+            "tokens": np.asarray(tokens[:hit_len], np.int32),
+            "arrays": arrays, "pages": pages, "next": 0,
+            "t0": time.perf_counter(),
+        }
+
+    def _advance_restore(self, req: Request, slot: int, seq: np.ndarray):
+        """One restore slice: install up to ``restore_batch_pages``
+        pages through the warmup-compiled install program (async
+        dispatches — the following ``_decode_once`` in the same
+        scheduler iteration overlaps them with live slots' decode
+        steps, the PR 2 dispatch-pipeline discipline). When the last
+        page lands, the prefix registers in the HBM cache and the
+        admission proceeds as a plain prefix hit — restored-hit ≡
+        never-evicted hit, bit-for-bit."""
+        r = self._restore
+        batch = max(1, int(self._tiers.config.restore_batch_pages))
+        overlapped = bool(self._slot_req)
+        end = min(r["next"] + batch, len(r["pages"]))
+        for i in range(r["next"], end):
+            self._arena = self._install_page(
+                self._arena, self._page_slice_tree(r["arrays"], i),
+                r["pages"][i],
+            )
+        r["next"] = end
+        self.kv_restore_batches += 1
+        if overlapped:
+            self.kv_restore_batches_overlapped += 1
+        if end < len(r["pages"]):
+            return
+        # all pages installed: publish to the prefix cache (entries take
+        # the refs), stamp the request's restore hop, and plan the
+        # admission — whose lookup now takes the freshly restored hit
+        self._prefix.insert(r["tokens"], r["pages"], tenant=req.tenant)
+        for p in r["pages"]:
+            self._allocator.release(p)
+        if r["tier"] == "peer":
+            self.kv_pages_imported += len(r["pages"])
+        self.kv_restores += 1
+        req.kv_restore_tier = r["tier"]
+        req.kv_restore_pages = len(r["pages"])
+        req.kv_restore_ms = round((time.perf_counter() - r["t0"]) * 1e3, 3)
+        self._restore = None
+        self._restored_tier = r["tier"]
+        try:
+            self._admitting[2] = self._paged_admit_plan(req, slot, seq)
+        finally:
+            self._restored_tier = None
+
+    def kv_directory(self) -> dict:
+        """Digest directory of this replica's exportable (HBM-cached)
+        prefixes — what ``GET /v1/kv/directory`` serves and peers'
+        TieredStores poll before pulling over ``/v1/kv/export``. Digest
+        is the prefix cache's content key (blake2b-16 of the int32
+        token bytes), hex-encoded; a peer holding the same tokens
+        computes the same digest locally, so no token lists travel
+        until a pull actually happens."""
+        prefixes = []
+        if self._prefix is not None:
+            for entry in self._prefix.entries.values():
+                prefixes.append({
+                    "digest": entry.key.hex(),
+                    "token_len": int(entry.token_len),
+                })
+        return {
+            "version": 1, "replica": self.replica,
+            "page_size": self.page_size or 0,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "prefixes": prefixes,
+        }
 
     # -- KV handoff (prefill -> decode replicas, session migration) ---------
 
@@ -1621,10 +1861,21 @@ class ServingEngine:
 
         n_pages = -(-hit_len // self.page_size)
         ids = [int(p) for p in entry.pages[:n_pages]]
+        # per-page through the warmup-compiled gather (same as demotion):
+        # gather_pages' per-call id list would compile per distinct page
+        # count, and a donor serving peer pulls exports in steady state
+        from .pages import _page_axis as _pa
+
+        per_page = [
+            jax.device_get(self._gather_page(self._arena, p)) for p in ids
+        ]
+        gathered = [
+            np.concatenate([pp[i] for pp in per_page],
+                           axis=_pa(per_page[0][i]))
+            for i in range(len(per_page[0]))
+        ]
         leaves = []
-        for (path, leaf), pages in zip(
-            self._kv_leaf_specs(), gather_pages(self._arena, ids)
-        ):
+        for (path, leaf), pages in zip(self._kv_leaf_specs(), gathered):
             leaves.append({
                 "path": path,
                 "dtype": pages.dtype.name,
@@ -1645,21 +1896,13 @@ class ServingEngine:
             "leaves": leaves,
         }
 
-    def import_prefix_kv(self, handoff: dict) -> int:
-        """Install a peer's KV handoff into this arena's prefix cache:
-        allocate pages, write each payload page through the (warmup-
-        compiled) install program, register the token prefix — so the
-        next admission of those tokens takes the prefix-hit path exactly
-        as if this replica had prefilled them itself. Returns the token
-        length now served from cache (0 when page pressure blocked the
-        install — a handoff is an optimization, never worth shedding live
-        work for). Raises ValueError on an incompatible wire format
-        (page size, KV dtype, or leaf layout mismatch)."""
-        if not self.page_size or self._prefix is None:
-            raise ValueError(
-                "KV handoff needs the paged arena with the prefix cache "
-                "(page_size=..., prefix_cache=True)"
-            )
+    def _handoff_arrays(self, handoff: dict):
+        """Validate a KV handoff dict against this arena's wire
+        identity (version, page size, KV dtype, leaf layout) and decode
+        its payload. Returns ``(tokens, token_len, n_pages, arrays)``;
+        raises ValueError on any mismatch. Shared by the import
+        endpoint and the peer-tier restore path — one validator, so a
+        peer pull can never install what an import would reject."""
         if handoff.get("version") != 1:
             raise ValueError(f"unknown KV handoff version {handoff.get('version')!r}")
         if int(handoff["page_size"]) != self.page_size:
@@ -1677,9 +1920,6 @@ class ServingEngine:
         n_pages = int(handoff["n_pages"])
         if tokens.size != token_len or n_pages != -(-token_len // self.page_size):
             raise ValueError("KV handoff token/page accounting is inconsistent")
-        have, _ = self._prefix.peek(tokens)
-        if have >= token_len:
-            return have  # already cached at least this deep: nothing to do
         import base64
 
         from .pages import _page_axis
@@ -1707,6 +1947,27 @@ class ServingEngine:
                     f"leaf {path} ({leaf.dtype.name}, page-gathered {expect})"
                 )
             arrays.append(arr)
+        return tokens, token_len, n_pages, arrays
+
+    def import_prefix_kv(self, handoff: dict) -> int:
+        """Install a peer's KV handoff into this arena's prefix cache:
+        allocate pages, write each payload page through the (warmup-
+        compiled) install program, register the token prefix — so the
+        next admission of those tokens takes the prefix-hit path exactly
+        as if this replica had prefilled them itself. Returns the token
+        length now served from cache (0 when page pressure blocked the
+        install — a handoff is an optimization, never worth shedding live
+        work for). Raises ValueError on an incompatible wire format
+        (page size, KV dtype, or leaf layout mismatch)."""
+        if not self.page_size or self._prefix is None:
+            raise ValueError(
+                "KV handoff needs the paged arena with the prefix cache "
+                "(page_size=..., prefix_cache=True)"
+            )
+        tokens, token_len, n_pages, arrays = self._handoff_arrays(handoff)
+        have, _ = self._prefix.peek(tokens)
+        if have >= token_len:
+            return have  # already cached at least this deep: nothing to do
         pages = []
         try:
             for _ in range(n_pages):
@@ -1772,7 +2033,15 @@ class ServingEngine:
                 seq = req.prompt
                 prefill_rng, decode_rng = jax.random.split(req.rng)
             if self.page_size:
-                plan = self._paged_admit_plan(req, slot, seq)
+                # tier probe BEFORE the admit plan: a host/disk/peer hit
+                # longer than HBM's best sets up a staged restore (plan
+                # None until the pages land); otherwise plan immediately
+                restore = self._plan_restore(req, seq)
+                if restore is not None:
+                    self._restore = restore
+                    plan = None
+                else:
+                    plan = self._paged_admit_plan(req, slot, seq)
             else:
                 plan = self._plan_chunks(seq.size)
             self._admitting = [req, slot, plan, 0, prefill_rng, decode_rng, seq]
@@ -1782,6 +2051,11 @@ class ServingEngine:
                 else:
                     tr.on_admission(req, slot, time.perf_counter() - req.submit_t)
         req, slot, plan, idx, prefill_rng, decode_rng, seq = self._admitting
+        if plan is None:
+            # restore in flight: one page batch per scheduler iteration,
+            # so the decode step right after overlaps the installs
+            self._advance_restore(req, slot, seq)
+            return True
         start, bucket = plan[idx]
         chunk = np.zeros((1, bucket), np.int32)
         seg = seq[start:start + bucket]
@@ -2264,6 +2538,21 @@ class ServingEngine:
                     # reuse-after-evict distances — the evidence base for
                     # a host/disk KV tier (ROADMAP item 2)
                     out.update(self._prefix.ghost.gauges())
+            if self._tiers is not None:
+                out.update(self._tiers.gauges())
+                lookups = self._prefix.lookups if self._prefix else 0
+                for tier, hits in self.kv_tier_hits.items():
+                    out[f"serving/kv_tier_hits_{tier}"] = hits
+                    out[f"serving/kv_tier_hit_ratio_{tier}"] = (
+                        hits / lookups if lookups else 0.0
+                    )
+                out["serving/kv_restores"] = self.kv_restores
+                out["serving/kv_restores_aborted"] = self.kv_restores_aborted
+                out["serving/kv_restore_batches"] = self.kv_restore_batches
+                out["serving/kv_restore_overlap_frac"] = (
+                    self.kv_restore_batches_overlapped / self.kv_restore_batches
+                    if self.kv_restore_batches else 0.0
+                )
         if self.spec_k:
             out["serving/spec_proposed"] = self.spec_proposed
             out["serving/spec_accepted"] = self.spec_accepted

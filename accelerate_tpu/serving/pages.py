@@ -161,6 +161,12 @@ class PrefixEntry:
     pages: tuple  # page ids covering [0, token_len)
     hits: int = 0
     last_used: int = 0
+    # the entry's own token prefix + owning tenant: what the demote-on-
+    # evict hook (serving/tiers.py) needs to rebuild the handoff blob
+    # and attribute tier byte-seconds. None on entries inserted by
+    # callers that predate tiering — those just can't demote.
+    tokens: Optional[np.ndarray] = None
+    tenant: str = "default"
 
 
 class _GhostShadow:
@@ -326,7 +332,9 @@ class PrefixCache:
     """
 
     def __init__(self, allocator: PageAllocator, page_size: int,
-                 max_entries: int = 512, ghost_multiples=(2, 4, 10)):
+                 max_entries: int = 512, ghost_multiples=(2, 4, 10),
+                 ghost_base_entries: Optional[int] = None,
+                 on_evict=None):
         self.allocator = allocator
         self.page_size = int(page_size)
         self.max_entries = int(max_entries)
@@ -335,10 +343,23 @@ class PrefixCache:
         self.lookups = 0
         self.hits = 0
         self.hit_tokens = 0
+        # demote-on-evict hook: called with the victim PrefixEntry
+        # BEFORE its page refs are released (the pages are still intact
+        # on device, so the hook can gather them into a lower tier)
+        self.on_evict = on_evict
         # ghost-cache economics telemetry (keys only — a few dict ops per
-        # lookup/insert; pass ghost_multiples=None/() to disable)
+        # lookup/insert; pass ghost_multiples=None/() to disable).
+        # ghost_base_entries overrides the shadows' 1x base: with a
+        # host/disk tier attached, the base is the TOTAL (HBM+host+disk)
+        # entry capacity so the 2x/4x/10x ratios keep answering "would a
+        # bigger cache help?" about capacity beyond what now exists,
+        # instead of re-measuring the tier just built.
         self.ghost = (
-            GhostCache(self.max_entries, ghost_multiples)
+            GhostCache(
+                int(ghost_base_entries) if ghost_base_entries
+                else self.max_entries,
+                ghost_multiples,
+            )
             if ghost_multiples else None
         )
 
@@ -390,12 +411,13 @@ class PrefixCache:
                 entry.hits += 1
                 entry.last_used = self._tick()
 
-    def insert(self, prompt: np.ndarray, pages) -> int:
+    def insert(self, prompt: np.ndarray, pages, tenant: str = "default") -> int:
         """Register ``prompt`` (whose KV now lives in ``pages``, position
         order) at every page-aligned prefix length plus its full length.
         Each new entry retains its covered pages. Returns the number of
         entries created."""
         ps = self.page_size
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
         n = int(prompt.size)
         lengths = list(range(ps, n + 1, ps))
         if n % ps:
@@ -411,6 +433,7 @@ class PrefixCache:
             entry = PrefixEntry(
                 key=key, token_len=length, pages=tuple(int(p) for p in pages[:n_pages]),
                 last_used=self._tick(),
+                tokens=prompt[:length].copy(), tenant=str(tenant or "default"),
             )
             for p in entry.pages:
                 self.allocator.retain(p)
@@ -425,11 +448,21 @@ class PrefixCache:
     def evict_lru(self) -> bool:
         """Drop the least-recently-used entry (releasing its page refs);
         False when the cache is empty. Called by the engine when the
-        allocator cannot satisfy an admission or a decode-time page grow."""
+        allocator cannot satisfy an admission or a decode-time page grow.
+        With a demote hook attached, the victim's KV is offered to the
+        lower tiers first — eviction demotes instead of dropping."""
         if not self.entries:
             return False
         key = min(self.entries, key=lambda k: self.entries[k].last_used)
         entry = self.entries.pop(key)
+        if self.on_evict is not None:
+            # pages are still retained here: the hook may gather them
+            try:
+                self.on_evict(entry)
+            except Exception:
+                # demotion is an optimization; a failing tier must never
+                # turn an eviction into an engine error
+                pass
         for p in entry.pages:
             self.allocator.release(p)
         if self.ghost is not None:
@@ -636,6 +669,25 @@ def gather_pages(arena, page_ids):
             continue
         g = jnp.take(leaf, ids, axis=_page_axis(leaf))
         out.append(np.asarray(jax.device_get(g)))
+    return out
+
+
+def gather_page(arena, src):
+    """Size-1 page slice of every K/V leaf at page ``src``, arena
+    flatten order — the demote-on-evict read, and the exact mirror of
+    :func:`install_page`'s write. Traced ``src``: one compiled program
+    gathers any page, so a warmed engine demotes evicted prefixes into
+    the host tier with zero recompiles (``gather_pages`` above, with
+    its per-call id *list*, would compile per distinct page count)."""
+    import jax
+
+    out = []
+    for leaf in jax.tree_util.tree_leaves(arena):
+        if not _is_kv(leaf):
+            continue
+        out.append(
+            jax.lax.dynamic_slice_in_dim(leaf, src, 1, axis=_page_axis(leaf))
+        )
     return out
 
 

@@ -252,6 +252,13 @@ class ReplicaServer:
                 "queue_depth": m.get("serving/queue_depth"),
                 "free_slots": m.get("serving/free_slots"),
             })
+        elif handler.path == "/v1/kv/directory":
+            # the peer-tier contract: advertise which prefixes this
+            # replica can export, so a peer's miss becomes a pull
+            # instead of a cold prefill (docs/serving.md)
+            with self._engine_lock:
+                directory = self.engine.kv_directory()
+            self._send_json(handler, directory)
         else:
             handler.send_error(404)
 

@@ -233,6 +233,21 @@ class HttpTransport:
         finally:
             conn.close()
 
+    def get_json(self, base_url: str, path: str) -> dict:
+        conn = self._conn(base_url)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status >= 400:
+                raise ConnectionError(
+                    f"replica {base_url}{path} answered {resp.status}: "
+                    f"{data[:200]!r}"
+                )
+            return json.loads(data) if data else {}
+        finally:
+            conn.close()
+
 
 class Router:
     """Least-loaded + session-affinity placement with failover/re-queue
@@ -536,6 +551,36 @@ class Router:
                     })
         except (OSError, ConnectionError, ValueError):
             pass
+
+    def kv_directory(self) -> dict:
+        """Merged prefix directory across every reachable replica — the
+        fleet's advertised warm-KV inventory (the peer tier's discovery
+        contract, ``docs/serving.md``). Each digest maps to its longest
+        advertised prefix and the replicas holding it; an unreachable
+        replica is simply absent (a directory is a hint, never truth —
+        the pull itself re-validates)."""
+        with self._lock:
+            replicas = dict(self._replicas)
+        merged: dict = {}
+        for name, url in replicas.items():
+            try:
+                doc = self.transport.get_json(url, "/v1/kv/directory")
+            except (OSError, ConnectionError, ValueError):
+                continue
+            for row in (doc or {}).get("prefixes") or []:
+                if not isinstance(row, dict) or not row.get("digest"):
+                    continue
+                d = str(row["digest"])
+                cur = merged.setdefault(
+                    d, {"digest": d, "token_len": 0, "replicas": []}
+                )
+                cur["token_len"] = max(
+                    cur["token_len"], int(row.get("token_len") or 0)
+                )
+                cur["replicas"].append(name)
+        return {"version": 1, "prefixes": sorted(
+            merged.values(), key=lambda r: r["digest"]
+        )}
 
     # -- the request path ---------------------------------------------------
 
@@ -926,6 +971,8 @@ class RouterServer:
     def _get(self, handler):
         if handler.path == "/v1/placement":
             self._send_json(handler, {"placement": self.router.placement()})
+        elif handler.path == "/v1/kv/directory":
+            self._send_json(handler, self.router.kv_directory())
         elif handler.path in ("/metrics", "/"):
             # ride THE exposition renderer (telemetry/exporter) through a
             # rollup shim, not a hand-rolled formatter: name sanitization

@@ -320,6 +320,15 @@ _COUNTER_KEYS = frozenset({
     "router/requeue_success", "router/kv_migrations",
     "canary/probes_sent", "canary/probes_passed", "canary/probes_failed",
     "serving/ghost_reuses",
+    # KV-tiering counters (PR 17): demotions/restores/pulls are monotone
+    # work done — a dead replica's contribution stays in the fleet total
+    "serving/kv_demotions_host", "serving/kv_demotions_disk",
+    "serving/kv_disk_corrupt_dropped",
+    "serving/kv_peer_pulls", "serving/kv_peer_pull_failures",
+    "serving/kv_tier_hits_hbm", "serving/kv_tier_hits_host",
+    "serving/kv_tier_hits_disk", "serving/kv_tier_hits_peer",
+    "serving/kv_restores", "serving/kv_restores_aborted",
+    "serving/kv_restore_batches",
 })
 # per-member counter families under a dynamic tail (tenant ids, replica
 # names, shed reasons): counters by prefix. No trailing slash on the
@@ -332,7 +341,10 @@ _MEAN_SUFFIXES = ("_frac", "_ratio", "_pct", "occupancy", "_rate",
                   # ghost-cache simulated hit ratios (a "_ratio" family,
                   # but the capacity-multiple tail hides the suffix)
                   "ghost_hit_ratio_2x", "ghost_hit_ratio_4x",
-                  "ghost_hit_ratio_10x")
+                  "ghost_hit_ratio_10x",
+                  # per-tier hit ratios (same hidden-suffix shape)
+                  "kv_tier_hit_ratio_hbm", "kv_tier_hit_ratio_host",
+                  "kv_tier_hit_ratio_disk", "kv_tier_hit_ratio_peer")
 # last_pass_unix_s: the canary freshness watermark is "when did ANY
 # probe last verify the service" — fleet-newest; e2e_ttft_ms gauges are
 # last-probe latencies — fleet-worst

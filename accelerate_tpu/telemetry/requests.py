@@ -213,6 +213,18 @@ class RequestTracer:
         for attr in ("prefix_hit", "pages_allocated", "spec_proposed",
                      "spec_accepted"):
             rec[attr] = int(getattr(req, attr, 0) or 0)
+        # tiered-KV restore hop (PR 17): which tier fed this request's
+        # prefix hit and what the pull cost — the waterfall's kv_restore
+        # stage and `trace summary --request-id` read these
+        kr_ms = float(getattr(req, "kv_restore_ms", 0.0) or 0.0)
+        if kr_ms:
+            rec["kv_restore_ms"] = round(kr_ms, 3)
+            rec["kv_restore_pages"] = int(
+                getattr(req, "kv_restore_pages", 0) or 0
+            )
+        tier = getattr(req, "kv_restore_tier", None)
+        if tier:
+            rec["kv_restore_tier"] = str(tier)
         total_s = (req.finish_t or time.perf_counter()) - req.submit_t
         rec["total_ms"] = round(total_s * 1e3, 3)
         rec["compiles_in_flight"] = self._compiles() - rec.pop("compiles_at_submit")

@@ -189,17 +189,38 @@ def build_scorecard(result, *, ttft_slo_ms: Optional[float] = None,
     if telemetry_dir:
         server = _load_server_records(telemetry_dir)
         joined = prefix_hit = 0
+        restores = 0
+        restore_ms = []
+        tier_hits: dict = {}
         for rec in records:
             srv = server.get(str(rec.get("request_id")))
             if srv is None:
                 continue
             joined += 1
             prefix_hit += int(srv.get("prefix_hit") or 0)
+            tier = srv.get("kv_restore_tier")
+            if tier:
+                restores += 1
+                tier_hits[tier] = tier_hits.get(tier, 0) + 1
+                kr = srv.get("kv_restore_ms")
+                if kr:
+                    restore_ms.append(float(kr))
         card["join"] = {
             "server_records": len(server),
             "joined": joined,
             "prefix_hit_tokens": prefix_hit,
         }
+        if restores:
+            # tiered-KV restores joined from the request records: how
+            # many admissions resumed from a lower tier and what the
+            # pull cost client-side (serving/tiers.py)
+            restore_ms.sort()
+            card["join"]["kv_restores"] = restores
+            card["join"]["kv_restore_tiers"] = tier_hits
+            if restore_ms:
+                card["join"]["kv_restore_ms_p50"] = round(
+                    restore_ms[len(restore_ms) // 2], 3
+                )
     return card
 
 

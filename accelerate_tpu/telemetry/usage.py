@@ -52,7 +52,8 @@ OVERFLOW_TENANT = "_other"
 FIELDS = (
     "submitted", "finished", "shed", "cancelled", "preempted",
     "prefill_tokens", "decode_tokens", "prefix_hit_tokens",
-    "page_seconds", "compute_ms",
+    "page_seconds", "host_byte_seconds", "disk_byte_seconds",
+    "compute_ms",
 )
 
 
@@ -68,16 +69,28 @@ class TenantUsage:
     decode_tokens: int = 0
     prefix_hit_tokens: int = 0
     page_seconds: float = 0.0
+    # KV-tier occupancy integrated over time (ROADMAP item 2): bytes a
+    # demoted prefix holds in host RAM / on disk, the billing-side twin
+    # of HBM page_seconds — same symmetric hook contract (held counts
+    # drain to 0 when the tier entry is dropped or promoted away)
+    host_byte_seconds: float = 0.0
+    disk_byte_seconds: float = 0.0
     compute_ms: float = 0.0
-    # live page-occupancy integration state
+    # live occupancy integration state
     pages_held: int = 0
+    host_bytes_held: int = 0
+    disk_bytes_held: int = 0
     _last_t: float = field(default=0.0, repr=False)
 
     def as_dict(self) -> dict:
         out = {f: getattr(self, f) for f in FIELDS}
         out["page_seconds"] = round(out["page_seconds"], 4)
+        out["host_byte_seconds"] = round(out["host_byte_seconds"], 4)
+        out["disk_byte_seconds"] = round(out["disk_byte_seconds"], 4)
         out["compute_ms"] = round(out["compute_ms"], 3)
         out["pages_held"] = self.pages_held
+        out["host_bytes_held"] = self.host_bytes_held
+        out["disk_bytes_held"] = self.disk_bytes_held
         return out
 
 
@@ -115,8 +128,14 @@ class UsageAccountant:
         return t
 
     def _integrate(self, t: TenantUsage, now: float):
-        if t.pages_held > 0 and now > t._last_t:
-            t.page_seconds += t.pages_held * (now - t._last_t)
+        if now > t._last_t:
+            dt = now - t._last_t
+            if t.pages_held > 0:
+                t.page_seconds += t.pages_held * dt
+            if t.host_bytes_held > 0:
+                t.host_byte_seconds += t.host_bytes_held * dt
+            if t.disk_bytes_held > 0:
+                t.disk_byte_seconds += t.disk_bytes_held * dt
         t._last_t = now
 
     def note_submit(self, tenant: str):
@@ -166,6 +185,22 @@ class UsageAccountant:
                 # release without a matched retain (flat arena, double
                 # release): clamp — page_seconds must stay non-negative
                 t.pages_held = 0
+
+    def note_tier_bytes(self, tenant: str, tier: str, delta: int,
+                        now: Optional[float] = None):
+        """A tenant's demoted-KV footprint in ``tier`` ("host" or
+        "disk") changed by ``delta`` bytes. Same symmetric contract as
+        :meth:`note_pages`: occupancy accrued so far is integrated
+        first, held counts clamp at 0 on unmatched release."""
+        if tier not in ("host", "disk"):
+            return
+        now = self._clock() if now is None else float(now)
+        attr = f"{tier}_bytes_held"
+        with self._lock:
+            t = self._tenant(tenant)
+            self._integrate(t, now)
+            held = getattr(t, attr) + int(delta)
+            setattr(t, attr, held if held > 0 else 0)
 
     def advance(self, now: Optional[float] = None):
         """Bring every tenant's page-seconds current (rollup/sample time)."""
@@ -274,6 +309,10 @@ class UsageAccountant:
                         round(v, 3) if isinstance(v, float) else v
                     )
                 out[f"usage/{name}/pages_held"] = t.pages_held
+                if t.host_bytes_held or t.host_byte_seconds:
+                    out[f"usage/{name}/host_bytes_held"] = t.host_bytes_held
+                if t.disk_bytes_held or t.disk_byte_seconds:
+                    out[f"usage/{name}/disk_bytes_held"] = t.disk_bytes_held
             if out:
                 out["usage/tenants"] = len(self.tenants)
             return out

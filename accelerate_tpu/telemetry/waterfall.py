@@ -38,9 +38,12 @@ from typing import Optional
 
 from .histograms import StreamingHistogram
 
-# stage order IS the request's causal order; renderers keep it
+# stage order IS the request's causal order; renderers keep it.
+# kv_restore is the tiered-KV pull (host/disk/peer → HBM) a warm
+# session-resume pays instead of a cold prefill — carved out of the
+# replica's TTFT so a tier regression shows up as its own row
 STAGES = ("router_queue", "placement", "retry_backoff", "transport",
-          "replica_queue", "prefill")
+          "replica_queue", "kv_restore", "prefill")
 
 
 def load_router_requests(target) -> list:
@@ -128,21 +131,26 @@ def waterfall_stages(router_rec: dict, replica_rec: Optional[dict] = None) -> Op
         stages["retry_backoff"] = max(0.0, span_to_connect - placement)
     # inside the winning hop: transport + replica queue + prefill
     inside = _ms(win.get("connect_unix_s"), first_token) or 0.0
-    rq = pf = 0.0
+    rq = kr = pf = 0.0
     if replica_rec is not None:
         rq = float(replica_rec.get("queue_wait_ms") or 0.0)
+        kr = float(replica_rec.get("kv_restore_ms") or 0.0)
         ttft = replica_rec.get("ttft_ms")
-        pf = max(0.0, float(ttft) - rq) if ttft is not None else 0.0
-        if rq + pf > inside and (rq + pf) > 0:
+        # the replica's TTFT contains the tier restore (it runs inside
+        # admission); carve it out so prefill means compute
+        pf = max(0.0, float(ttft) - rq - kr) if ttft is not None else 0.0
+        if rq + kr + pf > inside and (rq + kr + pf) > 0:
             # replica durations overran the hop wall (coarse clocks /
             # sub-ms rounding): scale them into it so the stages still
             # sum — the split shifts, the total never lies
-            scale = inside / (rq + pf)
+            scale = inside / (rq + kr + pf)
             rq *= scale
+            kr *= scale
             pf *= scale
     stages["replica_queue"] = rq
+    stages["kv_restore"] = kr
     stages["prefill"] = pf
-    stages["transport"] = max(0.0, inside - rq - pf)
+    stages["transport"] = max(0.0, inside - rq - kr - pf)
     stages = {k: round(v, 3) for k, v in stages.items()}
     e2e = round(sum(stages.values()), 3)
     top = max(STAGES, key=lambda s: stages[s])

@@ -1662,6 +1662,151 @@ def _loadtest_bench(cfg, *, page_size=16, num_slots=2):
     }
 
 
+def _kv_tier_bench(cfg, *, page_size=16, num_slots=2, baseline=None):
+    """The KV-tiering economics rows (docs/serving.md "Hierarchical KV
+    tiering"): the ghost shadows priced the headroom, this drill cashes
+    it in.
+
+    Phase A replays the same canonical workload as ``_loadtest_bench``
+    on an engine whose evictions demote into a host+disk tier 4x the
+    HBM prefix cache (12 host + 12 disk entries over the 6-entry HBM
+    cache), publishing ``kv_tier_hit_ratio_{hbm,host,disk,peer}`` and
+    ``kv_restore_overlap_frac``. Against the untiered ``baseline`` row
+    it asserts the tiers close at least half the gap between the real
+    hit ratio and the 4x ghost ratio — the headroom the economics
+    telemetry promised must actually be collectable.
+
+    Phase B is the session-resume drill: warm a long prompt, evict it
+    into a host tier 10x the HBM cache, resubmit, and time first-token
+    wall vs a cold prefill of the same length — ``session_resume_ttft_
+    p50`` must beat ``session_cold_ttft_p50`` (restoring pages is
+    cheaper than recomputing them, or the tiers are pointless).
+    """
+    import dataclasses
+    import tempfile
+
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving import loadgen
+    from accelerate_tpu.serving.engine import ServingEngine
+    from accelerate_tpu.serving.tiers import TierConfig
+    from accelerate_tpu.telemetry import scorecard as sc
+
+    spec = loadgen.WorkloadSpec.load(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "workload_canonical.json",
+    ))
+    need = spec.prompt_cap + 16
+    cap = -(-min(cfg.max_seq_len, need) // page_size) * page_size
+    model_def = DecoderLM(dataclasses.replace(cfg, max_cache_len=cap))
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(0), batch_size=1, seq_len=spec.prompt_cap
+    )
+    params, _ = unbox_params(variables["params"])
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        engine = ServingEngine(
+            model_def, params, num_slots=num_slots, max_cache_len=cap,
+            prefill_chunks=(page_size, 2 * page_size), page_size=page_size,
+            prefix_max_entries=6,  # same HBM cache the baseline ran with
+            kv_tiers=TierConfig(host_entries=12, disk_entries=12,
+                                disk_dir=td),
+        )
+        engine.telemetry = None
+        engine.warmup()
+        engine.mark_steady()
+        result = loadgen.run(spec, engine, time_scale=0.0, timeout_s=120)
+        card = sc.build_scorecard(result, chips=max(1, jax.device_count()))
+        counts = card["counts"]
+        assert card["conserved"] and counts["in_flight"] == 0, (
+            f"tiered canonical drill did not conserve/drain: {counts}"
+        )
+        assert engine.admission_recompiles == 0, (
+            "KV tiering recompiled post-steady (the gather/install "
+            "programs must be warmup-compiled)"
+        )
+        m = engine.metrics()
+        hit = m.get("serving/prefix_hit_ratio", 0.0)
+        out["kv_tier_prefix_hit_ratio"] = round(hit, 4)
+        for tier in ("hbm", "host", "disk", "peer"):
+            out[f"kv_tier_hit_ratio_{tier}"] = round(
+                m.get(f"serving/kv_tier_hit_ratio_{tier}", 0.0), 4
+            )
+        out["kv_restores"] = int(m.get("serving/kv_restores", 0))
+        out["kv_restore_overlap_frac"] = round(
+            m.get("serving/kv_restore_overlap_frac", 0.0), 4
+        )
+    if baseline:
+        base = float(baseline.get("prefix_hit_ratio", 0.0))
+        ghost = float(baseline.get("ghost_hit_ratio_4x", base))
+        if ghost > base:
+            out["kv_tier_gap_closed_frac"] = round(
+                (hit - base) / (ghost - base), 4
+            )
+            assert hit >= base + 0.5 * (ghost - base) - 1e-9, (
+                f"host+disk tiers at 4x capacity closed less than half "
+                f"the ghost gap: hit={hit:.4f} base={base:.4f} "
+                f"ghost_4x={ghost:.4f}"
+            )
+
+    # phase B: session resume vs cold prefill, host tier 10x the arena
+    cap_b = min(8 * page_size, (cfg.max_seq_len // page_size) * page_size)
+    prompt_len = cap_b - page_size
+    model_b = DecoderLM(dataclasses.replace(cfg, max_cache_len=cap_b))
+    engine = ServingEngine(
+        model_b, params, num_slots=num_slots, max_cache_len=cap_b,
+        prefill_chunks=(page_size, 2 * page_size), page_size=page_size,
+        prefix_max_entries=6,
+        # insert registers every page-aligned prefix as its own entry, so
+        # entry counts scale with pages; 60 host entries comfortably holds
+        # every demotion this drill produces — 10x the HBM entry cache
+        kv_tiers=TierConfig(host_entries=60),
+    )
+    engine.telemetry = None
+    engine.warmup()
+    engine.mark_steady()
+    rng = np.random.default_rng(20260807)
+    trials = 5
+    prompts = [rng.integers(1, cfg.vocab_size, size=prompt_len,
+                            dtype=np.int64).tolist() for _ in range(2 * trials)]
+
+    def _ttft(prompt):
+        t0 = time.perf_counter()
+        req = engine.submit(prompt, max_new_tokens=1, seed=7)
+        engine.run()
+        assert req.outcome == "finished"
+        return 1e3 * (time.perf_counter() - t0), req
+
+    # cold first (nothing cached yet), then warm the resume prompts and
+    # push them out of HBM into the host tier so the resubmits below must
+    # restore, not just re-hit
+    cold = [_ttft(p)[0] for p in prompts[trials:]]
+    for p in prompts[:trials]:
+        engine.submit(p, max_new_tokens=1, seed=7)
+    engine.run()
+    while engine._prefix.evict_lru():
+        pass
+    resumed = []
+    for p in prompts[:trials]:
+        ms, req = _ttft(p)
+        assert req.kv_restore_tier == "host", (
+            f"session resume did not restore from the host tier "
+            f"(kv_restore_tier={req.kv_restore_tier!r})"
+        )
+        resumed.append(ms)
+    out["session_cold_ttft_p50"] = round(float(np.median(cold)), 2)
+    out["session_resume_ttft_p50"] = round(float(np.median(resumed)), 2)
+    assert out["session_resume_ttft_p50"] < out["session_cold_ttft_p50"], (
+        f"restoring {prompt_len}-token KV from host RAM did not beat the "
+        f"cold prefill it replaces: resume={out['session_resume_ttft_p50']}"
+        f"ms cold={out['session_cold_ttft_p50']}ms"
+    )
+    assert engine.admission_recompiles == 0, (
+        "the session-resume drill recompiled post-steady"
+    )
+    return out
+
+
 def _pipeline_mem_worker():
     """Compiled temp-memory (stash + belts) for gpipe-under-AD vs the manual
     1F1B schedule at M=4S, on the 8-device CPU sim (the schedule's win is a
@@ -2012,6 +2157,17 @@ def main():
                     "loadtest_goodput_tokens_per_chip",
                     "ghost_hit_ratio_4x"):
             extra[key] = extra["loadtest"][key]
+        # KV-tiering economics: the same canonical drill with the
+        # host+disk tiers on (asserted to close >= half the ghost gap)
+        # plus the session-resume-vs-cold-prefill TTFT race
+        extra["kv_tiering"] = _kv_tier_bench(
+            ttft_cfg, page_size=64, baseline=extra["loadtest"],
+        )
+        for key in ("session_resume_ttft_p50", "session_cold_ttft_p50",
+                    "kv_restore_overlap_frac", "kv_tier_hit_ratio_hbm",
+                    "kv_tier_hit_ratio_host", "kv_tier_hit_ratio_disk",
+                    "kv_tier_hit_ratio_peer"):
+            extra[key] = extra["kv_tiering"][key]
         # the transfer_flush noise rows (median-of-rounds + spread; the
         # best-attempt phase breakdown above keeps the old shape)
         for v in ("bf16", "int8", "int4"):
@@ -2147,6 +2303,15 @@ def main():
                     "loadtest_goodput_tokens_per_chip",
                     "ghost_hit_ratio_4x"):
             extra[key] = extra["loadtest"][key]
+        extra["kv_tiering"] = _kv_tier_bench(
+            DecoderConfig.tiny(max_seq_len=256), page_size=16,
+            baseline=extra["loadtest"],
+        )
+        for key in ("session_resume_ttft_p50", "session_cold_ttft_p50",
+                    "kv_restore_overlap_frac", "kv_tier_hit_ratio_hbm",
+                    "kv_tier_hit_ratio_host", "kv_tier_hit_ratio_disk",
+                    "kv_tier_hit_ratio_peer"):
+            extra[key] = extra["kv_tiering"][key]
 
     # static-audit regression rows (both branches; post-warmup pass)
     extra.update(_audit_rows())
