@@ -704,6 +704,14 @@ def collect_diff_metrics(target: str) -> dict:
                 out[f"waterfall/{stage}/{field}"] = float(row[field])
     if isinstance(wf.get("e2e_ttft_p99_ms"), (int, float)):
         out["router_e2e_ttft_p99_ms"] = float(wf["e2e_ttft_p99_ms"])
+    # which prefill path served the joined requests: a round where
+    # `waterfall/prefill_kernel_dense` grows at `_ragged`'s expense is a
+    # kernel-gate regression even if the p99 hasn't moved yet. (Bench-side
+    # `prefill_kernel_speedup` / `prefill_pad_waste_frac` need no code
+    # here — `_flatten_numeric` lifts every numeric in the bench extras.)
+    for mode, count in (wf.get("prefill_kernel") or {}).items():
+        if isinstance(count, (int, float)) and not isinstance(count, bool):
+            out[f"waterfall/prefill_kernel_{mode}"] = float(count)
     canary = data.get("canary") or {}
     if isinstance(canary.get("pass_ratio"), (int, float)):
         out["canary_pass_ratio"] = float(canary["pass_ratio"])

@@ -98,6 +98,18 @@ class DecoderConfig:
     # the paged arena always walks in kv_page_size blocks).
     decode_kernel: Optional[str] = None
     decode_kernel_block: Optional[int] = None
+    # prefill-attention implementation for the packed ragged prefill over
+    # the paged arena (ops/attention.ragged_prefill_attention). None ->
+    # the ATT_PREFILL_KERNEL env knob (default "ragged": the flash
+    # online-softmax pallas kernel on TPU — one dispatch packs every
+    # pending admission tail, prefix pages already in the arena are
+    # skipped at the block level — with a warn-once dense fallback
+    # elsewhere); "dense" forces the reference path (the bit-exactness
+    # oracle); "interpret" runs the same kernel through the pallas
+    # interpreter (the CPU test/CI mode). ``prefill_kernel_block`` tunes
+    # the token-block granule rows are packed to (default 8).
+    prefill_kernel: Optional[str] = None
+    prefill_kernel_block: Optional[int] = None
     # fp8 recipe (ops/fp8.py): every Linear-equivalent contraction (QKV/O + MLP) runs e4m3-fwd/e5m2-bwd.
     # Flipped on by Accelerator(mixed_precision="fp8"). ``fp8_recipe``:
     # "current" (per-tensor amax each step, XLA fuses the reduction) or
@@ -190,6 +202,16 @@ class DecoderConfig:
             raise ValueError(
                 f"decode_kernel_block must be a positive block size, got "
                 f"{self.decode_kernel_block}"
+            )
+        if self.prefill_kernel not in (None, "ragged", "dense", "interpret"):
+            raise ValueError(
+                "prefill_kernel must be None, 'ragged', 'dense' or "
+                f"'interpret', got {self.prefill_kernel!r}"
+            )
+        if self.prefill_kernel_block is not None and self.prefill_kernel_block < 1:
+            raise ValueError(
+                f"prefill_kernel_block must be a positive token-block size, "
+                f"got {self.prefill_kernel_block}"
             )
         if self.moe_num_experts == 1:
             raise ValueError("moe_num_experts must be 0 (dense) or >= 2")

@@ -182,6 +182,18 @@ class DecoderAttention(nn.Module):
     forks, preemption page-outs and prefix-cache hits move the quantized
     payload + scales verbatim — nothing is ever re-quantized.
 
+    ``ragged_slots`` + ``slot_hist`` (with a paged cache) switch the call
+    to the packed ragged PREFILL form: batch row 0's sequence axis packs
+    every pending admission's tail — row r is token ``cache_positions[0,
+    r]`` of slot ``ragged_slots[r]`` (-1 = token-block padding) — and the
+    flash prefill kernel (``ops/attention.ragged_prefill_attention``,
+    ``config.prefill_kernel`` / ``ATT_PREFILL_KERNEL``) attends each row
+    against its slot's live arena prefix plus the packed fresh rows,
+    with quantize-on-write fused so the page-table scatter lands the
+    kernel's payload+scales directly. One dispatch replaces the per-slot
+    bucketed chunk programs; padding waste drops from bucket-size to
+    token-block granularity.
+
     ``causal=False`` (+ optional ``kv_mask``) is the bidirectional form the
     seq2seq encoder reuses (models/seq2seq.py) — same projections, RoPE and
     logical axes, no cache. Ring attention over a "sequence" mesh axis is
@@ -196,7 +208,8 @@ class DecoderAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, sin, cos, deterministic: bool = True, kv_mask=None,
-                 cache_positions=None, page_table=None):
+                 cache_positions=None, page_table=None, ragged_slots=None,
+                 slot_hist=None):
         cfg = self.config
         e, h, kv, d = cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
         b, s = x.shape[0], x.shape[1]
@@ -270,8 +283,9 @@ class DecoderAttention(nn.Module):
                 raise NotImplementedError(
                     "a paged KV cache (config.kv_page_size) supports only "
                     "slot-arena decode (decode=True with cache_positions "
-                    "and page_table); prefill runs against dense per-slot "
-                    "gather views built by serving/pages.py"
+                    "and page_table); prefill runs either as the packed "
+                    "ragged dispatch (ragged_slots/slot_hist) or against "
+                    "dense per-slot gather views built by serving/pages.py"
                 )
             if not self.decode:
                 # prefill: cache starts at 0, so plain causal attention over
@@ -296,6 +310,63 @@ class DecoderAttention(nn.Module):
                     cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, 0, 0, 0))
                 cache_index.value = jnp.asarray(s, jnp.int32)
                 out = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+            elif ragged_slots is not None:
+                # packed ragged prefill over the paged arena (serving/):
+                # the batch axis is ONE packed dispatch of every pending
+                # admission tail — row r of the sequence axis is token
+                # position cache_positions[0, r] of slot ragged_slots[r]
+                # (-1 rows are token-block padding). The flash prefill
+                # kernel (ops/attention.ragged_prefill_attention) attends
+                # each row against its slot's live arena prefix
+                # (slot_hist, prefix-aware block skipping) plus the packed
+                # fresh rows at <= its own position, and quantize-on-write
+                # is fused: the kernel emits payload+scales which the
+                # scatter below lands through the page table in the same
+                # program — no separate quantize pass, no bucket padding.
+                if not paged:
+                    raise NotImplementedError(
+                        "ragged_slots (packed ragged prefill) requires the "
+                        "paged KV arena (config.kv_page_size)"
+                    )
+                if b != 1:
+                    raise ValueError(
+                        f"packed ragged prefill packs all tails into one "
+                        f"batch row; got batch {b}"
+                    )
+                from ..ops.attention import ragged_prefill_attention
+
+                row_pos = (
+                    cache_positions[0]
+                    if cache_positions.ndim == 2 else cache_positions
+                )
+                scale_kw = {}
+                if kvq_bits:
+                    scale_kw = {"k_scale": cached_ks.value,
+                                "v_scale": cached_vs.value,
+                                "kv_quant_bits": kvq_bits}
+                out, k_pay, k_scl, v_pay, v_scl = ragged_prefill_attention(
+                    q, k, v, cached_k.value, cached_v.value,
+                    page_table=page_table, row_slot=ragged_slots,
+                    row_pos=row_pos, slot_hist=slot_hist,
+                    impl=getattr(cfg, "prefill_kernel", None),
+                    token_block=getattr(cfg, "prefill_kernel_block", None),
+                    **scale_kw,
+                )
+                # fused scatter through the page table. Pad rows (-1) route
+                # to physical page 0 — the arena's reserved parking page —
+                # so the scatter stays a fixed-shape data move with no
+                # masking branch; parking content is never attended.
+                ps = cfg.kv_page_size
+                valid = (ragged_slots >= 0) & (row_pos >= 0)
+                srow = jnp.maximum(ragged_slots, 0)
+                spos = jnp.maximum(row_pos, 0)
+                page = jnp.where(valid, page_table[srow, spos // ps], 0)
+                off = spos % ps
+                cached_k.value = cached_k.value.at[page, :, off].set(k_pay)
+                cached_v.value = cached_v.value.at[page, :, off].set(v_pay)
+                if kvq_bits:
+                    cached_ks.value = cached_ks.value.at[page, :, off].set(k_scl)
+                    cached_vs.value = cached_vs.value.at[page, :, off].set(v_scl)
             elif cache_positions is not None:
                 # slot-arena decode (serving/): every batch row writes its
                 # new K/V at its own per-slot offset(s) and attends only
@@ -462,14 +533,15 @@ class DecoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, sin, cos, deterministic: bool = True, cache_positions=None,
-                 page_table=None):
+                 page_table=None, ragged_slots=None, slot_hist=None):
         cfg = self.config
         ln1 = self.param("ln_attn", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         ln2 = self.param("ln_mlp", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)), (cfg.embed_dim,))
         y = rms_norm(x, ln1, cfg.norm_eps)
         y = DecoderAttention(cfg, self.mesh, self.use_cache, self.decode, name="attn")(
             y, sin, cos, deterministic, cache_positions=cache_positions,
-            page_table=page_table,
+            page_table=page_table, ragged_slots=ragged_slots,
+            slot_hist=slot_hist,
         )
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
@@ -501,13 +573,15 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        # cpos/ptab ride the carry like sin/cos (broadcast inputs every
-        # layer reads unchanged); None when the slot-arena path is off
-        x, aux, sin, cos, cpos, ptab = carry
+        # cpos/ptab/rslots/shist ride the carry like sin/cos (broadcast
+        # inputs every layer reads unchanged); None when the slot-arena /
+        # ragged-prefill paths are off
+        x, aux, sin, cos, cpos, ptab, rslots, shist = carry
         x, block_aux = DecoderBlock(self.config, self.mesh, self.use_cache, self.decode, name="block")(
-            x, sin, cos, self.deterministic, cache_positions=cpos, page_table=ptab
+            x, sin, cos, self.deterministic, cache_positions=cpos, page_table=ptab,
+            ragged_slots=rslots, slot_hist=shist,
         )
-        return (x, aux + block_aux, sin, cos, cpos, ptab), None
+        return (x, aux + block_aux, sin, cos, cpos, ptab, rslots, shist), None
 
 
 class StageStack(nn.Module):
@@ -530,9 +604,9 @@ class StageStack(nn.Module):
             length=cfg.num_layers // cfg.pipeline_stages,
             metadata_params={nn.PARTITION_NAME: "layer"},
         )
-        (x, aux, _, _, _, _), _ = Stack(
+        (x, aux, _, _, _, _, _, _), _ = Stack(
             cfg, self.mesh, deterministic=deterministic, name="layers"
-        )((x, jnp.float32(0.0), sin, cos, None, None), None)
+        )((x, jnp.float32(0.0), sin, cos, None, None, None, None), None)
         if cfg.moe_num_experts > 1:
             # per-(stage, microbatch) router load-balance sum over this
             # stage's layers; the schedule accumulates and renormalizes
@@ -561,6 +635,8 @@ class DecoderLM(nn.Module):
         decode: bool = False,
         cache_positions: Optional[jax.Array] = None,
         page_table: Optional[jax.Array] = None,
+        ragged_slots: Optional[jax.Array] = None,
+        slot_hist: Optional[jax.Array] = None,
     ):
         cfg = self.config
         b, s = input_ids.shape
@@ -572,6 +648,16 @@ class DecoderLM(nn.Module):
         if page_table is not None and cache_positions is None:
             raise ValueError(
                 "page_table (paged slot-arena decode) requires cache_positions"
+            )
+        if (ragged_slots is not None) != (slot_hist is not None):
+            raise ValueError(
+                "ragged_slots and slot_hist (packed ragged prefill) must be "
+                "set together"
+            )
+        if ragged_slots is not None and page_table is None:
+            raise ValueError(
+                "ragged_slots (packed ragged prefill) requires page_table "
+                "and cache_positions"
             )
         if use_cache and self._effective_stages() > 1:
             raise NotImplementedError(
@@ -657,9 +743,10 @@ class DecoderLM(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layer"},
             )
-            (x, moe_aux, _, _, _, _), _ = ScanStack(
+            (x, moe_aux, _, _, _, _, _, _), _ = ScanStack(
                 cfg, self.mesh, use_cache, decode, deterministic, name="layers"
-            )((x, jnp.float32(0.0), sin, cos, cache_positions, page_table), None)
+            )((x, jnp.float32(0.0), sin, cos, cache_positions, page_table,
+               ragged_slots, slot_hist), None)
         else:
             block_cls = _maybe_streaming(DecoderBlock, cfg)
             if cfg.remat:
@@ -667,7 +754,8 @@ class DecoderLM(nn.Module):
             for i in range(cfg.num_layers):
                 x, block_aux = block_cls(cfg, self.mesh, use_cache, decode, name=f"layer_{i}")(
                     x, sin, cos, deterministic, cache_positions=cache_positions,
-                    page_table=page_table,
+                    page_table=page_table, ragged_slots=ragged_slots,
+                    slot_hist=slot_hist,
                 )
                 moe_aux = moe_aux + block_aux
 

@@ -719,9 +719,16 @@ def _decode_kernel_gate(mode: str, sq: int, d: int, blk: int,
     warn-once for environment/shape gates. ``quant_bits`` extends the
     compiled-mode shape rule to the operands the quantized kernel
     actually loads: int4's packed payload blocks are ``d // 2`` wide, so
-    the 128-multiple rule applies to THAT width (head_dim must be a
-    256-multiple compiled) — without this, an unsupported tiling would
-    surface as a Mosaic compile error instead of the dense fallback."""
+    the lane-multiple rule applies to THAT width — without it, an
+    unsupported tiling would surface as a Mosaic compile error instead
+    of the dense fallback.
+
+    Compiled head_dim floor is 64, not 128: a 64-wide head block maps
+    onto the 128-lane tile as a narrow tile Mosaic lane-pads internally,
+    trading lane occupancy on the K/V loads for keeping the live-token
+    walk — still far ahead of the masked-dense read that streams the
+    whole arena reservation. int4 packs the payload to ``d // 2``, so
+    its compiled floor is head_dim 128 (was 256)."""
     if mode == "dense":
         return False, False
     if sq > _DECODE_KERNEL_MAX_SQ:
@@ -737,18 +744,20 @@ def _decode_kernel_gate(mode: str, sq: int, d: int, blk: int,
     if jax.default_backend() != "tpu":
         _warn_decode_fallback(f"no TPU backend ({jax.default_backend()} process)")
         return False, False
-    if d % 128 != 0 or blk % 8 != 0:
+    if d % 64 != 0 or blk % 8 != 0:
         _warn_decode_fallback(
-            f"shape gate: head_dim {d} must be a 128-multiple and the kv "
-            f"block/page size {blk} an 8-multiple for the compiled kernel"
+            f"shape gate: head_dim {d} must be a 64-multiple (64 compiles "
+            f"as a lane-padded narrow tile) and the kv block/page size "
+            f"{blk} an 8-multiple for the compiled kernel; this dispatch "
+            "resolves to the gathered dequant + masked-dense read"
         )
         return False, False
-    if quant_bits == 4 and (d // 2) % 128 != 0:
+    if quant_bits == 4 and (d // 2) % 64 != 0:
         _warn_decode_fallback(
             f"shape gate: int4 KV packs the payload to head_dim/2 = "
-            f"{d // 2}, which must itself be a 128-multiple for the "
-            "compiled kernel (head_dim a 256-multiple); this dispatch "
-            "runs the gathered dequant + masked-dense read"
+            f"{d // 2}, which must itself be a 64-multiple for the "
+            "compiled kernel (head_dim a 128-multiple); this dispatch "
+            "resolves to the gathered dequant + masked-dense read"
         )
         return False, False
     return True, False
@@ -1170,6 +1179,538 @@ def paged_decode_attention(
         )
     return decode_attention(
         q, k_full, v_full, q_positions=q_positions, sm_scale=sm_scale, impl="dense"
+    )
+
+
+# ---------------------------------------------------------------------------
+# pallas ragged prefill kernel over the paged arena (ROADMAP item 3)
+#
+# The chunked dense prefill path pads every admission tail to a bucket,
+# gathers the slot's whole arena reservation into a dense view, attends,
+# and scatters the view back — per chunk. This kernel is the prefill
+# counterpart of the decode kernel above: ONE dispatch packs the fresh
+# tails of every pending admission into a fixed token capacity (rows are
+# (token, query-head-group) pairs; padding is only up to the token-block
+# granule, not a bucket), a scalar-prefetched per-block (slot, history)
+# map drives the page-table walk, and the kv sweep per token block is
+#
+#   [arena pages 0 .. ceil(hist/page)) → packed fresh blocks 0 .. i]
+#
+# with flash online softmax across both phases. Prefix-aware skipping is
+# structural: positions already served by a prefix-cache / tier hit are
+# never re-attended as QUERIES (only the fresh tail packs rows), and the
+# kv walk visits exactly the slot's live prefix pages — blocks past
+# ``ceil(hist/page)`` and fresh blocks of other slots (or causally-later
+# blocks of the same slot) are clamped in the index map and skipped by
+# ``pl.when``, so an elided block costs neither DMA nor compute.
+# Quantize-on-write is fused: the kernel quantizes each fresh K/V block
+# in-register (the exact ``utils.quantization.quantize_kv`` op
+# sequence), emits payload+scale outputs for the caller's single arena
+# scatter, and attends the tail over the DEQUANTIZED values — the same
+# read the cache serves later, so packed prefill stays bit-compatible
+# with the chunked dense oracle.
+# ---------------------------------------------------------------------------
+
+_PREFILL_KERNEL_MODES = ("ragged", "dense", "interpret")
+# default q token block: one sublane tile; the packer pads each tail to
+# this granule (vs a whole prefill bucket on the chunked path)
+_PREFILL_TOKEN_BLOCK = 8
+
+
+def resolve_prefill_kernel(impl: Optional[str] = None) -> str:
+    """Resolve the prefill-attention implementation choice: the explicit
+    ``impl`` (``DecoderConfig.prefill_kernel``) wins, else the
+    ``ATT_PREFILL_KERNEL`` env knob, else ``"ragged"`` (the packed pallas
+    kernel, with a warn-once chunked-dense fallback off-TPU).
+    ``"interpret"`` runs the same kernel through the pallas interpreter —
+    the CPU test/CI mode, so tier-1 asserts the identical kernel."""
+    mode = impl or os.environ.get("ATT_PREFILL_KERNEL", "ragged")
+    if mode not in _PREFILL_KERNEL_MODES:
+        raise ValueError(
+            f"ATT_PREFILL_KERNEL/prefill_kernel must be one of "
+            f"{_PREFILL_KERNEL_MODES}, got {mode!r}"
+        )
+    return mode
+
+
+def _warn_prefill_fallback(reason: str):
+    """Warn-once per distinct reason: the ragged prefill kernel was
+    requested (or defaulted) but this process resolves to the chunked
+    dense prefill path — admissions pay bucket padding and the per-chunk
+    gather/scatter round-trip."""
+    _warn_once(
+        "prefill:" + reason,
+        "ragged prefill kernel unavailable (%s); admissions resolve to "
+        "the chunked dense prefill path — TTFT pays bucket padding and a "
+        "gather/scatter round-trip per chunk. Set ATT_PREFILL_KERNEL="
+        "dense (or DecoderConfig.prefill_kernel='dense') to silence, or "
+        "'interpret' to run the kernel through the pallas interpreter.",
+        reason,
+    )
+
+
+def _prefill_kernel_gate(mode: str, d: int, ps: int, bt: int,
+                         quant_bits: int = 0):
+    """(use_kernel, interpret) for one ragged prefill dispatch. Shape
+    rules mirror the decode gate: head_dim a 64-multiple compiled (64
+    lane-pads as a narrow tile), page size and token block 8-multiples
+    (sublane tiles), int4 payload width ``d // 2`` itself a 64-multiple
+    (head_dim a 128-multiple)."""
+    if mode == "dense":
+        return False, False
+    if ps <= 0 or bt <= 0:
+        _warn_prefill_fallback("no valid page/token block size")
+        return False, False
+    if not _has_pltpu():
+        _warn_prefill_fallback("pallas TPU support missing from this jaxlib")
+        return False, False
+    if mode == "interpret":
+        return True, True
+    if jax.default_backend() != "tpu":
+        _warn_prefill_fallback(f"no TPU backend ({jax.default_backend()} process)")
+        return False, False
+    if d % 64 != 0 or ps % 8 != 0 or bt % 8 != 0:
+        _warn_prefill_fallback(
+            f"shape gate: head_dim {d} must be a 64-multiple and the page "
+            f"size {ps} / token block {bt} 8-multiples for the compiled "
+            "kernel; admissions resolve to the chunked dense prefill path"
+        )
+        return False, False
+    if quant_bits == 4 and (d // 2) % 64 != 0:
+        _warn_prefill_fallback(
+            f"shape gate: int4 KV packs the payload to head_dim/2 = "
+            f"{d // 2}, which must itself be a 64-multiple for the "
+            "compiled kernel (head_dim a 128-multiple); admissions "
+            "resolve to the chunked dense prefill path"
+        )
+        return False, False
+    return True, False
+
+
+def prefill_kernel_active(config) -> bool:
+    """Would a packed ragged prefill dispatch on a model with this config
+    run the pallas kernel in this process? The serving engine's admission
+    planner keys its SHAPE of work off this (packed ragged dispatch vs
+    per-slot bucket chunks) and bench/telemetry use it to decide whether
+    a dispatch bills the ``ragged_prefill_kernel`` roofline row — it must
+    mirror :func:`ragged_prefill_attention`'s gate exactly."""
+    page_size = getattr(config, "kv_page_size", None)
+    if not page_size:
+        return False
+    mode = resolve_prefill_kernel(getattr(config, "prefill_kernel", None))
+    if mode == "dense":
+        return False
+    bt = int(getattr(config, "prefill_kernel_block", None)
+             or _PREFILL_TOKEN_BLOCK)
+    quant_bits = {"int8": 8, "int4": 4}.get(
+        getattr(config, "kv_cache_dtype", "bf16"), 0
+    )
+    use, _ = _prefill_kernel_gate(
+        mode, int(getattr(config, "head_dim", 0) or 0), int(page_size), bt,
+        quant_bits,
+    )
+    return use
+
+
+def _quantize_block(x, bits):
+    """In-register quantize-on-write on one [rows, D] block: the EXACT
+    ``utils.quantization.quantize_kv`` op sequence (symmetric per-row
+    scale over D; int4 packs value pairs low-nibble-first). Returns
+    (payload int8 [rows, D or D/2], scale fp32 [rows, 1], deq fp32
+    [rows, D] — exactly what ``dequantize_kv`` hands a reader, so the
+    tail attends the same values the cache serves later)."""
+    qmax = (1 << (bits - 1)) - 1
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    qf = jnp.clip(jnp.round(x32 / scale), -qmax, qmax)
+    q = qf.astype(jnp.int8)
+    deq = qf * scale
+    if bits == 4:
+        r, dd = q.shape
+        pairs = q.reshape(r, dd // 2, 2)
+        payload = (pairs[:, :, 0] & 0x0F) | ((pairs[:, :, 1] & 0x0F) << 4)
+    else:
+        payload = q
+    return payload, scale, deq
+
+
+def _prefill_kernel_body(bslot_ref, bhist_ref, tbl_ref, q_ref, k_ref, v_ref,
+                         kn_ref, vn_ref, qpos_ref, kvpos_ref, o_ref,
+                         acc, m_scr, l_scr, *, sm_scale, ps, bt, group,
+                         npb, ntb, quant_bits=0, out_dtype=None,
+                         ks_ref=None, vs_ref=None, kq_ref=None, kso_ref=None,
+                         vq_ref=None, vso_ref=None):
+    """One (token-block i, kv-head h, kv-step j) cell of the ragged
+    prefill grid. j < ``npb`` walks the q block's slot's live arena pages
+    (the prefix already in the cache — dequantized in-register when the
+    arena is quantized); j >= ``npb`` walks the packed FRESH kv blocks,
+    attending only blocks of the same slot at causally-visible packed
+    positions. Fresh K/V is quantized in-register (quantize-on-write) —
+    payload+scale outputs are written every cell their output window
+    points at (identical values each visit, so revisits are benign) and
+    the tail attends the dequantized form, keeping bit-compatibility
+    with the chunked dense oracle that reads the cache back."""
+    i, j = pl.program_id(0), pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    slot = bslot_ref[i]
+    hist = bhist_ref[i]
+    n_hist_blocks = (hist + ps - 1) // ps
+    # per-row (token, head-group) query positions: row r is token r//group
+    qpos = qpos_ref[0, 0]  # [bt]
+    rowpos = jnp.broadcast_to(
+        qpos.reshape(bt, 1), (bt, group)
+    ).reshape(bt * group, 1)
+
+    # fresh K/V of the block this cell's fresh window points at (clamped
+    # to block 0 during the arena phase): quantize-on-write runs every
+    # cell so every visited output window holds the correct payload
+    kn = kn_ref[0, 0]
+    vn = vn_ref[0, 0]
+    if quant_bits:
+        kp, ksv, kdq = _quantize_block(kn, quant_bits)
+        vp, vsv, vdq = _quantize_block(vn, quant_bits)
+        kq_ref[0, 0] = kp
+        kso_ref[0, 0] = ksv
+        vq_ref[0, 0] = vp
+        vso_ref[0, 0] = vsv
+        k_fresh = kdq.astype(out_dtype)
+        v_fresh = vdq.astype(out_dtype)
+    else:
+        k_fresh, v_fresh = kn, vn
+
+    def _accumulate(s, valid, v):
+        m_prev = m_scr[...][:, :1]
+        l_prev = l_scr[...][:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        # a FULLY-masked row (a pad row, or a tail row in a skipped-slot
+        # block) keeps m_next = NEG_INF, where exp(s - m_next) is 1, not
+        # 0 — zero masked entries explicitly so its l stays 0 and the
+        # safe_l output is exactly 0 (partially-masked rows already
+        # underflow to 0 at the exp)
+        p = jnp.where(valid, jnp.exp(s - m_next), 0.0)
+        l_scr[...] = jnp.broadcast_to(
+            l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True), l_scr.shape
+        )
+        acc[...] = acc[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+
+    q = q_ref[0, 0]  # [bt*group, D]
+
+    @pl.when((slot >= 0) & (j < n_hist_blocks))
+    def _arena_phase():
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        if quant_bits:
+            from ..utils.quantization import dequantize_kv
+
+            k = dequantize_kv(k, ks_ref[0, 0], quant_bits, out_dtype)
+            v = dequantize_kv(v, vs_ref[0, 0], quant_bits, out_dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        kvp = j * ps + jax.lax.broadcasted_iota(
+            jnp.int32, (bt * group, ps), 1
+        )
+        # kvp < hist: only the slot's live prefix (stale arena rows past
+        # the frontier never score); kvp <= rowpos masks pad rows
+        valid = (kvp < hist) & (kvp <= rowpos)
+        s = jnp.where(valid, s, NEG_INF)
+        _accumulate(s, valid, v)
+
+    jf = j - npb
+    kslot = bslot_ref[jnp.clip(jf, 0, ntb - 1)]
+
+    @pl.when((slot >= 0) & (j >= npb) & (kslot == slot) & (jf <= i))
+    def _fresh_phase():
+        # packed tails are position-ordered per slot, so blocks of the
+        # same slot after this q block (jf > i) are entirely above the
+        # causal frontier — skipped at block level; the per-element mask
+        # below would zero them anyway
+        kvq = kvpos_ref[0, 0].reshape(1, bt)  # [1, bt] fresh positions
+        s = jax.lax.dot_general(
+            q, k_fresh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        valid = (kvq >= 0) & (kvq <= rowpos)
+        s = jnp.where(valid, s, NEG_INF)
+        _accumulate(s, valid, v_fresh)
+
+    @pl.when(j == nj - 1)
+    def _out():
+        l = l_scr[...][:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[...] / safe_l).astype(o_ref.dtype)
+
+
+def _prefill_quant_kernel_entry(bslot_ref, bhist_ref, tbl_ref, q_ref, k_ref,
+                                v_ref, ks_ref, vs_ref, kn_ref, vn_ref,
+                                qpos_ref, kvpos_ref, o_ref, kq_ref, kso_ref,
+                                vq_ref, vso_ref, acc, m_scr, l_scr, **kw):
+    _prefill_kernel_body(bslot_ref, bhist_ref, tbl_ref, q_ref, k_ref, v_ref,
+                         kn_ref, vn_ref, qpos_ref, kvpos_ref, o_ref,
+                         acc, m_scr, l_scr, ks_ref=ks_ref, vs_ref=vs_ref,
+                         kq_ref=kq_ref, kso_ref=kso_ref, vq_ref=vq_ref,
+                         vso_ref=vso_ref, **kw)
+
+
+def _ragged_prefill_kernel_call(q, k_new, v_new, k_pages, v_pages, page_table,
+                                row_slot, row_pos, slot_hist, sm_scale, bt,
+                                interpret, k_scale=None, v_scale=None,
+                                quant_bits=0):
+    _, h, cap, d = q.shape
+    _, kvh, ps, pd = k_pages.shape
+    group = h // kvh
+    ntb = cap // bt
+    g = bt * group
+    npb = page_table.shape[1]
+    # fold: per kv head, one [bt*group, D] block per token block, rows
+    # ordered (token, group member) — same convention as _fold_q_heads
+    q_r = (q[0].reshape(kvh, group, cap, d)
+           .transpose(0, 2, 1, 3).reshape(kvh, ntb, g, d))
+    kn_r = k_new[0].reshape(kvh, ntb, bt, d)
+    vn_r = v_new[0].reshape(kvh, ntb, bt, d)
+    blk_slot = row_slot.reshape(ntb, bt)[:, 0].astype(jnp.int32)
+    blk_hist = jnp.where(
+        blk_slot >= 0, slot_hist[jnp.maximum(blk_slot, 0)], 0
+    ).astype(jnp.int32)
+    pos_in = row_pos.reshape(ntb, 1, bt).astype(jnp.int32)
+
+    entry = _prefill_quant_kernel_entry if quant_bits else _prefill_kernel_body
+    kernel = functools.partial(
+        entry, sm_scale=sm_scale, ps=ps, bt=bt, group=group, npb=npb,
+        ntb=ntb, quant_bits=quant_bits, out_dtype=q.dtype,
+    )
+
+    def _page_spec(width):
+        # arena phase: walk the q block's slot's live prefix pages; dead
+        # steps (past ceil(hist/ps), or the whole fresh phase) re-address
+        # the last live page so their fetch is elided
+        return pl.BlockSpec(
+            (1, 1, ps, width),
+            lambda i, h_, j, bs, bh, tb: (
+                tb[jnp.maximum(bs[i], 0),
+                   jnp.clip(j, 0, jnp.maximum((bh[i] + ps - 1) // ps - 1, 0))],
+                h_, 0, 0,
+            ),
+        )
+
+    def _fresh_spec(width):
+        # fresh phase: packed kv block j - npb (clamped to 0 during the
+        # arena phase — its window doubles as the quantize-on-write
+        # target, so it must always point at a real block)
+        return pl.BlockSpec(
+            (1, 1, bt, width),
+            lambda i, h_, j, bs, bh, tb: (h_, jnp.clip(j - npb, 0, ntb - 1), 0, 0),
+        )
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d), lambda i, h_, j, bs, bh, tb: (h_, i, 0, 0)),
+        _page_spec(pd),
+        _page_spec(pd),
+    ]
+    operands = [q_r, k_pages, v_pages]
+    if quant_bits:
+        in_specs += [_page_spec(1), _page_spec(1)]
+        operands += [k_scale, v_scale]
+    in_specs += [
+        _fresh_spec(d),
+        _fresh_spec(d),
+        pl.BlockSpec((1, 1, bt), lambda i, h_, j, bs, bh, tb: (i, 0, 0)),
+        pl.BlockSpec((1, 1, bt),
+                     lambda i, h_, j, bs, bh, tb: (jnp.clip(j - npb, 0, ntb - 1), 0, 0)),
+    ]
+    operands += [kn_r, vn_r, pos_in, pos_in]
+
+    out_specs = [
+        pl.BlockSpec((1, 1, g, d), lambda i, h_, j, bs, bh, tb: (h_, i, 0, 0)),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((kvh, ntb, g, d), q.dtype)]
+    if quant_bits:
+        for width, dt in ((pd, jnp.int8), (1, jnp.float32),
+                          (pd, jnp.int8), (1, jnp.float32)):
+            out_specs.append(_fresh_spec(width))
+            out_shape.append(jax.ShapeDtypeStruct((kvh, ntb, bt, width), dt))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(ntb, kvh, npb + ntb),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[_vmem((g, d)), _vmem((g, 128)), _vmem((g, 128))],
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        # token blocks revisit the quantize-on-write output windows, so
+        # the grid's outer dim must stay sequential ("arbitrary")
+        **_grid_params(interpret, ("arbitrary", "parallel", "arbitrary")),
+    )(blk_slot, blk_hist, page_table.astype(jnp.int32), *operands)
+    o = outs[0]  # out_shape is a list, so pallas returns a list
+    out = (o.reshape(kvh, ntb, bt, group, d)
+           .transpose(0, 3, 1, 2, 4).reshape(1, h, cap, d))
+    if quant_bits:
+        k_pay = jnp.swapaxes(outs[1].reshape(kvh, cap, pd), 0, 1)
+        k_scl = jnp.swapaxes(outs[2].reshape(kvh, cap, 1), 0, 1)
+        v_pay = jnp.swapaxes(outs[3].reshape(kvh, cap, pd), 0, 1)
+        v_scl = jnp.swapaxes(outs[4].reshape(kvh, cap, 1), 0, 1)
+    else:
+        k_pay = jnp.swapaxes(k_new[0], 0, 1)
+        v_pay = jnp.swapaxes(v_new[0], 0, 1)
+        k_scl = v_scl = None
+    return out, k_pay, k_scl, v_pay, v_scl
+
+
+def _ragged_prefill_reference(q, k_new, v_new, k_pages, v_pages, page_table,
+                              row_slot, row_pos, slot_hist, scale,
+                              k_scale=None, v_scale=None, quant_bits=0):
+    """Chunked-dense-oracle math for a packed ragged dispatch: per-row
+    gathered arena context + packed fresh kv, masked exactly as the
+    kernel masks, through the reference op sequence (``quantize_kv`` /
+    ``dequantize_kv`` / fp32 softmax). The fallback path and the
+    bit-exactness reference the kernel is asserted against."""
+    from ..utils.quantization import dequantize_kv, quantize_kv
+
+    _, h, cap, d = q.shape
+    kvh = k_pages.shape[1]
+    group = h // kvh
+    kn_t = jnp.swapaxes(k_new[0], 0, 1)  # [CAP, KVH, D]
+    vn_t = jnp.swapaxes(v_new[0], 0, 1)
+    if quant_bits:
+        k_pay, k_scl = quantize_kv(kn_t, quant_bits)
+        v_pay, v_scl = quantize_kv(vn_t, quant_bits)
+        k_fresh = dequantize_kv(k_pay, k_scl, quant_bits, q.dtype)
+        v_fresh = dequantize_kv(v_pay, v_scl, quant_bits, q.dtype)
+    else:
+        k_pay, v_pay = kn_t, vn_t
+        k_scl = v_scl = None
+        k_fresh, v_fresh = kn_t, vn_t
+    k_ctx = gather_kv_pages(k_pages, page_table)  # [S, KVH, L, pd]
+    v_ctx = gather_kv_pages(v_pages, page_table)
+    if quant_bits:
+        k_ctx = dequantize_kv(
+            k_ctx, gather_kv_pages(k_scale, page_table), quant_bits, q.dtype)
+        v_ctx = dequantize_kv(
+            v_ctx, gather_kv_pages(v_scale, page_table), quant_bits, q.dtype)
+    sl = jnp.maximum(row_slot, 0)
+    k_row = k_ctx[sl]  # [CAP, KVH, L, D] — per-row slot context
+    v_row = v_ctx[sl]
+    qg = q[0].reshape(kvh, group, cap, d)
+    s_ctx = jnp.einsum(
+        "kgrd,rkld->kgrl", qg, k_row, preferred_element_type=jnp.float32
+    ) * scale
+    length = k_row.shape[2]
+    lpos = jnp.arange(length)
+    hist_r = jnp.where(row_slot >= 0, slot_hist[sl], 0)
+    valid_ctx = ((lpos[None, :] < hist_r[:, None])
+                 & (lpos[None, :] <= row_pos[:, None]))
+    s_ctx = jnp.where(valid_ctx[None, None], s_ctx, NEG_INF)
+    kf = jnp.swapaxes(k_fresh, 0, 1)  # [KVH, CAP, D]
+    vf = jnp.swapaxes(v_fresh, 0, 1)
+    s_new = jnp.einsum(
+        "kgrd,kcd->kgrc", qg, kf, preferred_element_type=jnp.float32
+    ) * scale
+    valid_new = ((row_slot[None, :] == row_slot[:, None])
+                 & (row_slot[:, None] >= 0)
+                 & (row_pos[None, :] <= row_pos[:, None])
+                 & (row_pos[None, :] >= 0))
+    s_new = jnp.where(valid_new[None, None], s_new, NEG_INF)
+    s = jnp.concatenate([s_ctx, s_new], axis=-1)
+    p = jax.nn.softmax(s, axis=-1)
+    out = (jnp.einsum("kgrl,rkld->kgrd", p[..., :length].astype(v_row.dtype), v_row)
+           + jnp.einsum("kgrc,kcd->kgrd", p[..., length:].astype(vf.dtype), vf))
+    # pad rows are fully masked: softmax degenerates to uniform — force
+    # the kernel's exact 0 output (safe_l semantics) instead
+    row_ok = (row_slot >= 0) & (row_pos >= 0)
+    out = jnp.where(row_ok[None, None, :, None], out, 0.0)
+    out = out.reshape(h, cap, d)[None].astype(q.dtype)
+    return out, k_pay, k_scl, v_pay, v_scl
+
+
+def ragged_prefill_attention(
+    q: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    *,
+    page_table: jax.Array,
+    row_slot: jax.Array,
+    row_pos: jax.Array,
+    slot_hist: jax.Array,
+    sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+    token_block: Optional[int] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    kv_quant_bits: int = 0,
+):
+    """Packed ragged prefill attention over the paged KV arena, with
+    quantize-on-write fused.
+
+    q/k_new/v_new: [1, H|KVH, CAP, D] — the packed fresh tails of every
+    admission in this dispatch (post-RoPE), CAP a fixed compile-time
+    capacity. ``row_slot``/``row_pos`` [CAP] int32 map each packed row to
+    its (slot, absolute position); -1 marks padding (only up to the
+    token-block granule). Rows of one slot must be contiguous,
+    position-ordered, and token-block aligned — the packer's contract.
+    ``slot_hist`` [S] int32 is each slot's live prefix length (tokens
+    already in the arena: a prefix-cache/tier hit plus earlier packed
+    dispatches of a long tail); the kernel walks exactly
+    ``ceil(hist/page_size)`` arena pages per token block and never
+    re-attends served positions as queries — the prefix-aware skip.
+
+    Returns ``(out [1, H, CAP, D], k_payload, k_scale, v_payload,
+    v_scale)`` — payloads token-major [CAP, KVH, pd] ready for one arena
+    scatter (scales None unquantized; payloads then pass through k_new/
+    v_new). Dispatch mirrors the decode kernel's:
+    :func:`resolve_prefill_kernel` (``impl`` / ``ATT_PREFILL_KERNEL``,
+    default "ragged" with a warn-once dense fallback off-TPU,
+    "interpret" for CPU tests); the chunked-dense reference stays the
+    bit-exactness oracle."""
+    mode = resolve_prefill_kernel(impl)
+    b, h, cap, d = q.shape
+    if b != 1:
+        raise ValueError(f"packed ragged prefill takes batch 1, got {b}")
+    bt = int(token_block or _PREFILL_TOKEN_BLOCK)
+    if cap % bt:
+        raise ValueError(
+            f"packed capacity {cap} must be a multiple of the token "
+            f"block {bt}"
+        )
+    if kv_quant_bits and (k_scale is None or v_scale is None):
+        raise ValueError("kv_quant_bits needs k_scale and v_scale")
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    row_slot = jnp.asarray(row_slot, jnp.int32)
+    row_pos = jnp.asarray(row_pos, jnp.int32)
+    slot_hist = jnp.asarray(slot_hist, jnp.int32)
+    if mode != "dense":
+        use, interpret = _prefill_kernel_gate(
+            mode, d, k_pages.shape[2], bt, kv_quant_bits
+        )
+        if use:
+            return _ragged_prefill_kernel_call(
+                q, k_new, v_new, k_pages, v_pages, page_table, row_slot,
+                row_pos, slot_hist, scale, bt, interpret,
+                k_scale=k_scale, v_scale=v_scale, quant_bits=kv_quant_bits,
+            )
+    return _ragged_prefill_reference(
+        q, k_new, v_new, k_pages, v_pages, page_table, row_slot, row_pos,
+        slot_hist, scale, k_scale=k_scale, v_scale=v_scale,
+        quant_bits=kv_quant_bits,
     )
 
 

@@ -43,7 +43,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..generation import _sample, _sized_definition, depipeline
-from ..ops.attention import decode_kernel_active
+from ..ops.attention import (
+    _PREFILL_TOKEN_BLOCK,
+    decode_kernel_active,
+    prefill_kernel_active,
+)
 from .arena import arena_nbytes, init_arena, slot_view, write_slot
 from .pages import (
     NGramDrafter,
@@ -137,6 +141,11 @@ class Request:
     kv_restore_tier: Optional[str] = None
     kv_restore_ms: float = 0.0
     kv_restore_pages: int = 0
+    # which prefill path admitted this request: "ragged" (the packed
+    # flash prefill kernel / its interpreter) or "dense" (bucketed
+    # chunks) — the waterfall's prefill stage annotates kernel-vs-dense
+    # from this field on the request record
+    prefill_kernel: Optional[str] = None
 
     def result(self) -> np.ndarray:
         """[prompt + generated] token ids (the ``generate()`` contract)."""
@@ -379,6 +388,25 @@ class ServingEngine:
             self._kernel_costed_verify = bool(self.spec_k) and decode_kernel_active(
                 pcfg, sq=self.spec_k + 1
             )
+            # packed ragged prefill (ops/attention.ragged_prefill_attention):
+            # when the flash prefill kernel (or its interpreter) engages,
+            # the admission planner packs every pending tail into ONE
+            # ragged dispatch per scheduler iteration — token-block
+            # padding only — instead of per-slot bucketed chunks. The
+            # chunked path stays compiled as the fallback/oracle.
+            self._ragged_prefill = prefill_kernel_active(pcfg)
+            self._ragged_bt = int(
+                getattr(pcfg, "prefill_kernel_block", None)
+                or _PREFILL_TOKEN_BLOCK
+            )
+            rb = self._ragged_bt
+            # fixed grid capacities compiled at warmup (the zero-recompile
+            # invariant): each chunk bucket rounded up to the token block,
+            # deduped. The packer picks the smallest capacity that fits
+            # the round's packed tails.
+            self._ragged_caps = tuple(sorted(
+                {-(-int(c) // rb) * rb for c in self.prefill_chunks}
+            ))
             self._page_tables = jnp.zeros(
                 (self.num_slots, self.pages_per_slot), jnp.int32
             )
@@ -410,6 +438,9 @@ class ServingEngine:
             self._verify_step = None
             self._kernel_costed = False
             self._kernel_costed_verify = False
+            self._ragged_prefill = False
+            self._ragged_bt = _PREFILL_TOKEN_BLOCK
+            self._ragged_caps = ()
             self._arena = init_arena(definition, params, self.num_slots, self._placer)
         self.page_forks = 0
         self.kv_pages_exported = 0
@@ -428,6 +459,13 @@ class ServingEngine:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.prefill_chunks_skipped = 0
+        # prefill padding-waste accounting (both paths dispatch FIXED row
+        # counts — chunk buckets or ragged grid capacities — so waste =
+        # 1 - live/dispatched is directly comparable between them):
+        # the prefill_pad_waste_frac gauge and the TTFT bench read these
+        self.prefill_packed_tokens = 0      # live tokens via ragged packs
+        self._prefill_tokens_dispatched = 0  # live tokens, either path
+        self._prefill_rows_dispatched = 0    # grid/bucket rows, either path
         self.arena_bytes = arena_nbytes(self._arena)
         self._tokens = jnp.zeros((self.num_slots,), jnp.int32)
         self._lengths = jnp.zeros((self.num_slots,), jnp.int32)
@@ -480,6 +518,7 @@ class ServingEngine:
         self._decode_step = jax.jit(self._step_core, donate_argnums=donate)
         self._decode_bursts: dict = {}
         self._prefill_fns: dict = {}
+        self._ragged_fns: dict = {}
         self._admit_state = jax.jit(_admit_state_fn)
 
         # metrics
@@ -692,6 +731,48 @@ class ServingEngine:
         self._prefill_fns[bucket] = fn
         return fn
 
+    def _ragged_prefill_fn(self, cap: int):
+        fn = self._ragged_fns.get(cap)
+        if fn is not None:
+            return fn
+        definition, placer = self._paged_def, self._placer
+        temperature, top_k = self.temperature, self.top_k
+
+        def ragged_prefill(params, arena, ids, row_slot, row_pos, slot_hist,
+                           page_tables, last_rows, rngs):
+            # one packed flash-prefill dispatch over the paged arena: every
+            # pending tail rides the same [1, cap] token pack, the ragged
+            # kernel attends each row to its slot's arena prefix plus its
+            # own packed causal history, and quantize-on-write scatters
+            # payload+scales through the page table in the same program.
+            # Pad rows (slot/pos = -1) route to the parking page. A first
+            # token is sampled for EVERY slot from ``last_rows`` — the
+            # host only reads the rows of slots that actually completed a
+            # tail this dispatch, so the rest are dead lanes, not hazards.
+            positions = jnp.maximum(row_pos, 0)[None, :]
+            out, mutated = definition.apply(
+                {"params": placer(params), "cache": arena},
+                ids,  # [1, cap]
+                positions=positions,
+                use_cache=True,
+                decode=True,
+                cache_positions=row_pos[None, :],
+                page_table=page_tables,
+                ragged_slots=row_slot,
+                slot_hist=slot_hist,
+                mutable=["cache"],
+            )
+            rows = jnp.take(out["logits"][0], last_rows, axis=0)  # [S, V]
+            firsts = jax.vmap(
+                lambda key, row: _sample(row[None], key, temperature, top_k)[0]
+            )(rngs, rows)
+            return mutated["cache"], firsts
+
+        fn = jax.jit(ragged_prefill,
+                     donate_argnums=(1,) if self._donate else ())
+        self._ragged_fns[cap] = fn
+        return fn
+
     def warmup(self):
         """Compile every program this engine can ever dispatch — each
         prefill bucket, the admission scatter, the single decode step and
@@ -765,6 +846,43 @@ class ServingEngine:
                 # rollup/report taken before traffic already lists the
                 # executable (wall/bytes accumulate per decode dispatch)
                 costs.note_dynamic("paged_decode_kernel", 0.0, calls=0)
+            if self._ragged_prefill:
+                # the packed ragged-prefill programs, one per fixed grid
+                # capacity. All-pad warm args are safe: both kernel kv
+                # phases see zero live rows, quantize-on-write lands on
+                # the parking page (unreachable by construction), and
+                # the sampled firsts are discarded host-side.
+                warm_hist = jnp.zeros((self.num_slots,), jnp.int32)
+                warm_last = jnp.zeros((self.num_slots,), jnp.int32)
+                warm_rngs = jnp.zeros((self.num_slots, 2), jnp.uint32)
+                for rcap in self._ragged_caps:
+                    warm_ids = jnp.zeros((1, rcap), jnp.int32)
+                    warm_neg = jnp.full((rcap,), -1, jnp.int32)
+                    self._note_forensics(
+                        f"ragged_prefill_{rcap}", {"ids": warm_ids}
+                    )
+                    self._arena, _ = self._ragged_prefill_fn(rcap)(
+                        self.params, self._arena, warm_ids, warm_neg,
+                        warm_neg, warm_hist, self._page_tables, warm_last,
+                        warm_rngs,
+                    )
+                    if costs is not None:
+                        try:
+                            costs.capture_lowered(
+                                f"ragged_prefill_{rcap}",
+                                self._ragged_prefill_fn(rcap).lower(
+                                    self.params, self._arena, warm_ids,
+                                    warm_neg, warm_neg, warm_hist,
+                                    self._page_tables, warm_last,
+                                    warm_rngs,
+                                ))
+                        except Exception:
+                            pass
+                if costs is not None:
+                    # the kernel's dynamic roofline row, billed from
+                    # host-side packed-token counts per dispatch
+                    costs.note_dynamic("ragged_prefill_kernel", 0.0,
+                                       calls=0)
         self._tokens, self._lengths, self._rngs = self._admit_state(
             self._tokens, self._lengths, self._rngs, 0, 0, 0, rng
         )
@@ -899,6 +1017,22 @@ class ServingEngine:
                 donate=(0,) if donate_on else (), donate_expected=donate_on,
                 compute_dtype=dtype,
             ))
+            if self._ragged_prefill:
+                warm_hist = jnp.zeros((self.num_slots,), jnp.int32)
+                warm_last = jnp.zeros((self.num_slots,), jnp.int32)
+                warm_rngs = jnp.zeros((self.num_slots, 2), jnp.uint32)
+                for rcap in self._ragged_caps:
+                    warm_ids = jnp.zeros((1, rcap), jnp.int32)
+                    warm_neg = jnp.full((rcap,), -1, jnp.int32)
+                    specs.append(dict(
+                        name=f"ragged_prefill_{rcap}",
+                        fn=self._ragged_prefill_fn(rcap),
+                        args=(self.params, self._arena, warm_ids, warm_neg,
+                              warm_neg, warm_hist, self._page_tables,
+                              warm_last, warm_rngs),
+                        donate=(1,) if donate_on else (),
+                        donate_expected=donate_on, compute_dtype=dtype,
+                    ))
         return specs
 
     # -- request API -------------------------------------------------------
@@ -2056,6 +2190,11 @@ class ServingEngine:
             # so the decode step right after overlaps the installs
             self._advance_restore(req, slot, seq)
             return True
+        if self._ragged_prefill:
+            # flash prefill kernel engaged: one packed ragged dispatch
+            # replaces this iteration's bucket chunk (and may co-admit
+            # further queued tails into the same grid)
+            return self._ragged_advance(tr)
         start, bucket = plan[idx]
         chunk = np.zeros((1, bucket), np.int32)
         seg = seq[start:start + bucket]
@@ -2112,6 +2251,10 @@ class ServingEngine:
             # the dispatch wall, billed to the admitting tenant
             usage.note_prefill(req.tenant, int(seg.size))
             usage.note_compute(req.tenant, wall * 1e3)
+        # pad-waste accounting, comparable with the ragged path: the
+        # bucket is the dispatched row count, the segment is what's live
+        self._prefill_rows_dispatched += bucket
+        self._prefill_tokens_dispatched += int(seg.size)
         idx += 1
         if idx < len(plan):
             self._admitting[3] = idx
@@ -2136,6 +2279,7 @@ class ServingEngine:
             decode_rng,
         )
         req.slot = slot
+        req.prefill_kernel = "dense"
         self._slot_req[slot] = req
         self._active[slot] = True
         if resume:
@@ -2153,6 +2297,202 @@ class ServingEngine:
         # _last_token_t stays 0.0 until _emit sets it: the first token has
         # no preceding token, so it must not record a spurious 0.0 ITL gap
         self._emit(req, first_tok, now)
+        return True
+
+    def _ragged_advance(self, tr) -> bool:
+        """One packed ragged-prefill dispatch: the primary admission's
+        next tail segment plus — when capacity remains — the WHOLE tails
+        of further queued requests, packed token-block-aligned into the
+        smallest compiled grid capacity that fits. Replaces the per-slot
+        bucket chunks of the dense path (which stays compiled as the
+        fallback and bit-exactness oracle); preserves the interleave
+        discipline (one dispatch per scheduler iteration) and the
+        zero-recompile invariant (grid capacities fixed at warmup)."""
+        req, slot, plan, idx, prefill_rng, decode_rng, seq = self._admitting
+        bt = self._ragged_bt
+        cap_max = self._ragged_caps[-1]
+        # ``idx`` is repurposed by this path as the next global position
+        # to prefill (0 = nothing dispatched yet -> start past the
+        # prefix hit the admit plan recorded; a first dispatch always
+        # advances past position 0, so the sentinel is unambiguous)
+        cur = plan[0][0] if idx == 0 else idx
+        n = min(seq.size - cur, cap_max)
+        if self._faults is not None:
+            self._faults.before_prefill(self)
+        try:
+            self._ensure_writable(req, slot, cur, cur + n - 1)
+        except PagePressure:
+            # same ladder as the chunked dispatch: page out a strictly
+            # lower-priority victim before shedding the admission
+            resolved = self._relieve_pressure(req, slot)
+            if resolved:
+                try:
+                    self._ensure_writable(req, slot, cur, cur + n - 1)
+                except PagePressure:
+                    resolved = False
+            if not resolved:
+                self._abort_admission(
+                    time.perf_counter(), "shed", SHED_PAGE_EXHAUSTED
+                )
+                flight = getattr(self.telemetry, "flight", None)
+                if flight is not None:
+                    flight.note("request_shed", request_id=req.id,
+                                reason=SHED_PAGE_EXHAUSTED)
+                return True
+        # packs: [request, slot, s0, s1, prefill_rng, decode_rng, seq,
+        # primary]. The primary may be mid-tail (longer than the largest
+        # grid); co-admitted tails are always whole, so every co-admit
+        # completes in-dispatch and the admission singleton invariant
+        # (_reap/_abort only ever see self._admitting[0]) holds.
+        packs = [[req, slot, cur, cur + n, prefill_rng, decode_rng, seq,
+                  True]]
+        used = -(-n // bt) * bt
+        # co-admission: pull further queued requests into the same grid.
+        # FIFO only (a scheduler's WFQ/priority pick must stay one-at-a-
+        # time so its accounting observes each admission), no KV tiers
+        # (a tier probe can stage a restore, which needs the singleton),
+        # and a conservative no-hit fit check — a prefix hit only ever
+        # shrinks the tail, so fitting cold guarantees fitting planned.
+        if self._sched is None and self._tiers is None:
+            while self._free and self._queue and used + bt <= cap_max:
+                nxt = self._queue[0]
+                if nxt.done:
+                    self._queue.popleft()
+                    continue
+                if nxt._resume is not None:
+                    # resumes restore a saved RNG chain and emit nothing;
+                    # they admit alone through the singleton path
+                    break
+                if used + -(-int(nxt.prompt.size) // bt) * bt > cap_max:
+                    break
+                self._queue.popleft()
+                slot2 = self._free.pop()
+                p_rng, d_rng = jax.random.split(nxt.rng)
+                plan2 = self._paged_admit_plan(nxt, slot2, nxt.prompt)
+                hit2 = plan2[0][0]
+                n2 = int(nxt.prompt.size) - hit2
+                try:
+                    self._ensure_writable(nxt, slot2, hit2, hit2 + n2 - 1)
+                except PagePressure:
+                    # back out this co-admission and requeue at the head:
+                    # it re-admits alone next iteration, where the full
+                    # relieve/shed pressure ladder applies
+                    self._release_slot_pages(slot2, nxt.tenant)
+                    self._free.append(slot2)
+                    if nxt.prefix_hit:
+                        self.kv_tier_hits["hbm"] -= 1
+                        nxt.prefix_hit = 0
+                    self._queue.appendleft(nxt)
+                    break
+                if tr is not None:
+                    tr.on_admission(
+                        nxt, slot2, time.perf_counter() - nxt.submit_t
+                    )
+                packs.append([nxt, slot2, hit2, hit2 + n2, p_rng, d_rng,
+                              nxt.prompt, False])
+                used += -(-n2 // bt) * bt
+        rcap = next(c for c in self._ragged_caps if c >= used)
+        ids = np.zeros((1, rcap), np.int32)
+        row_slot = np.full((rcap,), -1, np.int32)
+        row_pos = np.full((rcap,), -1, np.int32)
+        hist = np.zeros((self.num_slots,), np.int32)
+        last_rows = np.zeros((self.num_slots,), np.int32)
+        rngs = np.zeros((self.num_slots, 2), np.uint32)
+        fresh = attended = read_tok = 0
+        ps = self.page_size
+        r = 0
+        for preq, psl, s0, s1, prng, _, pseq, _ in packs:
+            nseg = s1 - s0
+            nb = -(-nseg // bt)
+            ids[0, r:r + nseg] = pseq[s0:s1]
+            # pad rows of a pack's LAST block keep the slot id (the
+            # kernel reads the block's first row to name its slot; pads
+            # are dead through pos = -1, not slot = -1)
+            row_slot[r:r + nb * bt] = psl
+            row_pos[r:r + nseg] = np.arange(s0, s1)
+            hist[psl] = s0
+            last_rows[psl] = r + nseg - 1
+            rngs[psl] = np.asarray(jax.device_get(prng), np.uint32)
+            r += nb * bt
+            # host-side roofline counts for the dynamic cost row: causal
+            # qk pairs actually attended, and kv tokens streamed (each
+            # token block walks the slot's prefix pages plus the packed
+            # fresh blocks at or below it)
+            fresh += nseg
+            attended += (s1 * (s1 + 1) - s0 * (s0 + 1)) // 2
+            read_tok += nb * (-(-s0 // ps) * ps) + bt * nb * (nb + 1) // 2
+        ids_dev = jnp.asarray(ids)
+        self._note_forensics(f"ragged_prefill_{rcap}", {"ids": ids_dev})
+        t0 = time.perf_counter()
+        self._arena, firsts = self._ragged_prefill_fn(rcap)(
+            self.params, self._arena, ids_dev, jnp.asarray(row_slot),
+            jnp.asarray(row_pos), jnp.asarray(hist), self._page_tables,
+            jnp.asarray(last_rows), jnp.asarray(rngs),
+        )
+        firsts_h = np.asarray(jax.device_get(firsts))
+        wall = time.perf_counter() - t0
+        costs = (getattr(self.telemetry, "costs", None)
+                 if self.telemetry is not None else None)
+        if costs is not None:
+            costs.note_wall(f"ragged_prefill_{rcap}", wall)
+            costs.note_dynamic(
+                "ragged_prefill_kernel", wall,
+                flops=float(self._kernel_flops_per_token * attended),
+                hbm_bytes=float(self._kv_token_bytes * (read_tok + fresh)),
+                calls=1,
+            )
+        usage = self._usage()
+        self.prefill_packed_tokens += fresh
+        self._prefill_tokens_dispatched += fresh
+        self._prefill_rows_dispatched += rcap
+        now = time.perf_counter()
+        for preq, psl, s0, s1, prng, drng, pseq, primary in packs:
+            if tr is not None:
+                tr.on_prefill_chunk(preq, psl, s0, s1 - s0, t0, wall)
+            if usage is not None:
+                usage.note_prefill(preq.tenant, s1 - s0)
+                # the shared dispatch wall is billed proportionally to
+                # each tenant's live tokens in the pack
+                usage.note_compute(
+                    preq.tenant, wall * 1e3 * (s1 - s0) / max(fresh, 1)
+                )
+            if primary and s1 < pseq.size:
+                # mid-tail: the primary stays the admission singleton
+                # and resumes at position s1 next scheduler iteration
+                # (a mid-tail primary fills the whole grid, so it never
+                # coexists with co-admits)
+                self._admitting[3] = s1
+                continue
+            if primary:
+                self._admitting = None
+            resume = preq._resume is not None
+            if not resume:
+                self._insert_prefix(preq, psl)
+            if resume:
+                # the replayed slot continues where it was paged out:
+                # last emitted token, restored chain, no new emission
+                first_tok = int(preq.tokens[-1])
+                length = int(pseq.size)
+                preq._resume = None
+                self.resumptions += 1
+            else:
+                first_tok = int(firsts_h[psl])
+                length = int(preq.prompt.size)
+            self._tokens, self._lengths, self._rngs = self._admit_state(
+                self._tokens, self._lengths, self._rngs, psl, first_tok,
+                length, drng,
+            )
+            preq.slot = psl
+            preq.prefill_kernel = "ragged"
+            self._slot_req[psl] = preq
+            self._active[psl] = True
+            if resume:
+                preq._last_token_t = 0.0
+                continue
+            preq.first_token_t = now
+            if tr is not None:
+                tr.on_first_token(preq, now - preq.submit_t)
+            self._emit(preq, first_tok, now)
         return True
 
     def _burst_len(self) -> int:
@@ -2524,6 +2864,10 @@ class ServingEngine:
             out["serving/page_size"] = self.page_size
             out["serving/page_forks"] = self.page_forks
             out["serving/decode_kernel_active"] = bool(self._kernel_costed)
+            out["serving/prefill_kernel_active"] = bool(self._ragged_prefill)
+            out["serving/prefill_packed_tokens"] = int(
+                self.prefill_packed_tokens
+            )
             if self.kv_pages_exported or self.kv_pages_imported:
                 out["serving/kv_pages_exported"] = self.kv_pages_exported
                 out["serving/kv_pages_imported"] = self.kv_pages_imported
@@ -2553,6 +2897,15 @@ class ServingEngine:
                     self.kv_restore_batches_overlapped / self.kv_restore_batches
                     if self.kv_restore_batches else 0.0
                 )
+        if self._prefill_rows_dispatched:
+            # fraction of dispatched prefill rows that were padding —
+            # both paths dispatch fixed row counts (chunk buckets or
+            # ragged grid capacities), so the gauge compares them
+            # directly; the ragged packer's win is this number falling
+            out["serving/prefill_pad_waste_frac"] = (
+                1.0 - self._prefill_tokens_dispatched
+                / self._prefill_rows_dispatched
+            )
         if self.spec_k:
             out["serving/spec_proposed"] = self.spec_proposed
             out["serving/spec_accepted"] = self.spec_accepted

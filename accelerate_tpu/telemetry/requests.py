@@ -225,6 +225,12 @@ class RequestTracer:
         tier = getattr(req, "kv_restore_tier", None)
         if tier:
             rec["kv_restore_tier"] = str(tier)
+        # which prefill path admitted this request ("ragged" = the packed
+        # flash prefill kernel, "dense" = bucketed chunks): the TTFT
+        # waterfall annotates its prefill stage kernel-vs-dense from this
+        pk = getattr(req, "prefill_kernel", None)
+        if pk:
+            rec["prefill_kernel"] = str(pk)
         total_s = (req.finish_t or time.perf_counter()) - req.submit_t
         rec["total_ms"] = round(total_s * 1e3, 3)
         rec["compiles_in_flight"] = self._compiles() - rec.pop("compiles_at_submit")

@@ -154,7 +154,7 @@ def waterfall_stages(router_rec: dict, replica_rec: Optional[dict] = None) -> Op
     stages = {k: round(v, 3) for k, v in stages.items()}
     e2e = round(sum(stages.values()), 3)
     top = max(STAGES, key=lambda s: stages[s])
-    return {
+    row = {
         "request_id": router_rec.get("request_id"),
         "replica": win.get("replica"),
         "requeues": sum(1 for h in hops if "error" in h),
@@ -164,6 +164,12 @@ def waterfall_stages(router_rec: dict, replica_rec: Optional[dict] = None) -> Op
         "top_stage": top,
         "joined": replica_rec is not None,
     }
+    if replica_rec is not None and replica_rec.get("prefill_kernel"):
+        # annotate the prefill stage with which path ran it ("ragged" =
+        # the packed flash prefill kernel, "dense" = bucketed chunks), so
+        # a prefill-bound waterfall says whether the kernel was even on
+        row["prefill_kernel"] = str(replica_rec["prefill_kernel"])
+    return row
 
 
 def build_waterfalls(router_records: list, replica_records: list) -> list:
@@ -202,6 +208,7 @@ def summarize_waterfall(rows: list) -> dict:
     hists = {s: StreamingHistogram() for s in STAGES}
     totals = dict.fromkeys(STAGES, 0.0)
     top: dict = {}
+    pk_counts: dict = {}
     e2e = StreamingHistogram()
     for row in rows:
         for s in STAGES:
@@ -210,6 +217,9 @@ def summarize_waterfall(rows: list) -> dict:
             totals[s] += v
         e2e.add((row.get("e2e_ttft_ms") or 0.0) / 1e3)
         top[row["top_stage"]] = top.get(row["top_stage"], 0) + 1
+        pk = row.get("prefill_kernel")
+        if pk:
+            pk_counts[pk] = pk_counts.get(pk, 0) + 1
     grand = sum(totals.values())
     stages = {}
     for s in STAGES:
@@ -226,6 +236,10 @@ def summarize_waterfall(rows: list) -> dict:
     out = {"requests": len(rows),
            "joined": sum(1 for r in rows if r.get("joined")),
            "stages": stages, "top_stages": top}
+    if pk_counts:
+        # kernel-vs-dense split over the joined requests: a prefill-heavy
+        # share with "dense" dominating here is the tuning signal
+        out["prefill_kernel"] = pk_counts
     snap = e2e.snapshot()
     if snap:
         out["e2e_ttft_p50_ms"] = round(snap["p50_s"] * 1e3, 3)

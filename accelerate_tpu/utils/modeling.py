@@ -481,7 +481,11 @@ def _stream_device_leaves(device_paths, flat_loaded, shardings, dtype,
     stages run concurrently, so their sum can exceed the dispatch wall;
     that gap IS the measured overlap) and, when a telemetry span recorder
     is armed, emits per-leaf nested spans from its own thread, so the
-    Chrome trace shows the three lanes interleaving.
+    Chrome trace shows the three lanes interleaving. The ``transfer_flush``
+    phase is measured HERE, per chunk (the stall until the previous
+    chunk's async device_put lands, taken right before the next submit),
+    so it is pure link wall on the dispatch critical path — not the old
+    terminal whole-tree probe that also absorbed AOT-compile overlap.
 
     ``ATT_SERIAL_DISPATCH=1`` degrades to running the stages inline on the
     caller thread (bit-identical output; the A/B lever for the overlap and
@@ -508,11 +512,36 @@ def _stream_device_leaves(device_paths, flat_loaded, shardings, dtype,
     #                   | ("quant", path, qw_host, {childkey: sharding|None})
     pending_bytes = 0
     gate = _ByteGate(readahead)
+    # the previous chunk's device arrays, awaited right before the next
+    # chunk's submit (and once at the end of the stream). This measures
+    # the link stall PER BATCH, on the dispatch critical path, instead of
+    # one terminal whole-tree probe after dispatch returns — which also
+    # absorbed the overlapped AOT compile and so reported the 13-22 s
+    # "transfer_flush" wall the round-5 bench could neither reproduce nor
+    # attribute. Awaiting chunk N before submitting N+1 costs nothing:
+    # the link is busy with N's bytes either way.
+    prev_placed: list = []
+
+    def _await_prev():
+        if not prev_placed:
+            return
+        with phase("transfer_flush"):
+            import time as _time
+
+            for arr in prev_placed:
+                ready = getattr(arr, "is_ready", None)
+                if ready is None:
+                    jax.block_until_ready(arr)
+                    continue
+                while not ready():
+                    _time.sleep(0.001)
+        prev_placed.clear()
 
     def _flush_pending():
         nonlocal pending_bytes
         if not pending:
             return
+        _await_prev()
         vals, shards = [], []
         for kind, path, obj, shard in pending:
             if kind == "plain":
@@ -526,6 +555,9 @@ def _stream_device_leaves(device_paths, flat_loaded, shardings, dtype,
             placed = jax.device_put(vals, shards)
         else:
             placed = jax.device_put(vals)
+        prev_placed.extend(
+            a for a in placed if isinstance(a, jax.Array)
+        )
         i = 0
         for kind, path, obj, shard in pending:
             if kind == "plain":
@@ -607,6 +639,7 @@ def _stream_device_leaves(device_paths, flat_loaded, shardings, dtype,
             _submit_one(_quantize_one(path, value), 0)
         with phase("transfer_submit"):
             _flush_pending()
+        _await_prev()
         return out
 
     q_read: "queue.Queue" = queue.Queue(maxsize=4)
@@ -721,6 +754,7 @@ def _stream_device_leaves(device_paths, flat_loaded, shardings, dtype,
             assert not buf, f"dispatch pipeline dropped leaves {sorted(buf)}"
             with phase("transfer_submit"):
                 _flush_pending()
+            _await_prev()
     finally:
         # shut the pipeline down (normal completion: both workers are
         # already done and every signal below is a no-op): stop first so no

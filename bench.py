@@ -450,10 +450,15 @@ def _ttft_once(cfg, ckpt, prompt_len, quant=None, max_memory=None):
             model_def, ckpt, jnp.zeros((1, prompt_len), jnp.int32),
             device_map="auto", max_memory=max_memory, quantization_config=qc,
         )
-    # block until every async device_put has LANDED: a tiny jitted
-    # reduction over one element of each leaf depends on all transfers but
-    # moves only a scalar back. The probe's own compile is timed separately
-    # so transfer_flush stays what the docstring claims: the physical link.
+    # the per-batch link stalls are now measured INSIDE the stream
+    # (_stream_device_leaves awaits each chunk before the next submit and
+    # bills the wait to "transfer_flush"), so dispatch_total already
+    # contains the real flush wall. The terminal whole-tree probe survives
+    # only as a correctness witness + residual meter: anything it still
+    # waits on ("flush_residual", ~0 when the in-stream accounting is
+    # complete) is transfer work the stream failed to attribute — the old
+    # single terminal probe also absorbed AOT-compile overlap, which is
+    # how BENCH_r05 printed a 13-22 s "transfer_flush" nobody could pin.
     leaves = [
         l for l in jax.tree_util.tree_leaves(dispatched.params)
         if isinstance(l, jax.Array)
@@ -463,7 +468,7 @@ def _ttft_once(cfg, ckpt, prompt_len, quant=None, max_memory=None):
     )
     with phase("flush_probe_compile"):
         compiled_probe = probe.lower(leaves).compile()
-    with phase("transfer_flush"):
+    with phase("flush_residual"):
         float(jax.device_get(compiled_probe(leaves)))
     with phase("first_call"):
         out = dispatched(jnp.asarray(ids))
@@ -478,11 +483,15 @@ def _framework_ttft(phases: dict) -> float:
     costs (startup excluded, link weather excluded). ``transfer_flush`` is
     the physical byte movement over the (100x-swinging) tunnel — reporting
     it as "the metric" times the weather; this sum is the number the repo
-    can actually regress on."""
-    return sum(
+    can actually regress on. The flush is now measured per-batch INSIDE
+    the stream, so it lands inside ``dispatch_total`` and is subtracted
+    back out here (plus any terminal residual the stream missed)."""
+    fw = sum(
         phases.get(k, 0.0)
         for k in ("dispatch_total", "flush_probe_compile", "first_call")
     )
+    return max(0.0, fw - phases.get("transfer_flush", 0.0)
+               - phases.get("flush_residual", 0.0))
 
 
 def _streamed_stats(dispatched, device_budget: int) -> dict:
@@ -1124,6 +1133,146 @@ def _serving_ragged_bench(cfg, prompt_len, *, num_slots=8, page_size=16,
     return out
 
 
+def _serving_prefill_bench(cfg, prompt_len, *, num_slots=8, page_size=16,
+                           max_new=8, short_frac=0.75):
+    """TTFT rows for the ragged flash prefill kernel (PR 18): a mixed
+    admission burst — 75% short prompts (prompt_len/8), 25% long — against
+    one COARSE prefill bucket, the regime where the bucketed chunk path
+    pays the most padding and per-request dispatches.
+
+    TPU branch: the identical burst with the kernel (default dispatch) and
+    with ``prefill_kernel='dense'`` forced, publishing
+    `prefill_kernel_speedup` (admission->first-token p50 ratio, asserted
+    >= 1.0 when the kernel engages) and both waves' pad waste (ragged
+    asserted strictly below bucketed). CPU branch: the compiled kernel
+    cannot run, so it publishes an interpret-vs-dense token-PARITY witness
+    (`prefill_kernel_parity`) plus the same pad-waste comparison — the
+    packer runs identically under the interpreter."""
+    import dataclasses
+
+    from accelerate_tpu.models import DecoderConfig, DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving import ServingEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and ((cfg.head_dim or 0) % 64 or page_size % 8):
+        # the prefill kernel's shape gate (head_dim % 64, page % 8):
+        # promote so the row measures kernel-vs-dense, not dense-vs-dense
+        cfg = dataclasses.replace(cfg, head_dim=64)
+        page_size = max(page_size, 8)
+    if not on_tpu:
+        # CPU: the tiny model (the interpreter pays per-element Python
+        # cost, so the witness must stay small); prompt lengths shrink
+        # with it but the shape of the burst is identical
+        cfg = DecoderConfig.tiny(max_seq_len=256)
+        prompt_len = min(prompt_len, 32)
+        page_size = min(page_size, 8)
+        num_slots = min(num_slots, 4)
+    cap = -(-(prompt_len + max_new + 1) // page_size) * page_size
+    assert cap <= cfg.max_seq_len, (cap, cfg.max_seq_len)
+    rng = np.random.RandomState(0)
+    n_long = max(1, int(round(num_slots * (1 - short_frac))))
+    short_len = max(page_size, prompt_len // 8)
+    lengths = [prompt_len if i < n_long else short_len
+               for i in range(num_slots)]
+    prompts = [rng.randint(0, cfg.vocab_size, (l,)) for l in lengths]
+    out = {
+        "num_slots": num_slots, "page_size": page_size,
+        "short_frac": round(1 - n_long / num_slots, 3),
+        "short_len": short_len, "long_len": prompt_len,
+        "prefill_bucket": prompt_len,
+    }
+
+    def wave(prefill_kernel):
+        wcfg = dataclasses.replace(cfg, max_cache_len=cap,
+                                   prefill_kernel=prefill_kernel)
+        model_def = DecoderLM(wcfg)
+        variables = model_def.init_variables(
+            jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len
+        )
+        params, _ = unbox_params(variables["params"])
+        params = jax.device_put(
+            jax.tree_util.tree_map(lambda x: x.astype(wcfg.dtype), params)
+        )
+        # ONE coarse bucket: the bucketed path pays prompt_len rows per
+        # admission; the ragged packer pays token blocks per tail
+        engine = ServingEngine(
+            model_def, params, num_slots=num_slots, max_cache_len=cap,
+            prefill_chunks=(prompt_len,), page_size=page_size,
+            prefix_cache=False,
+        )
+        engine.telemetry = None
+        engine.warmup()
+        engine.mark_steady()
+        reqs = [engine.submit(p, max_new_tokens=max_new, seed=i)
+                for i, p in enumerate(prompts)]
+        engine.run()
+        assert all(r.outcome == "finished" for r in reqs)
+        assert engine.admission_recompiles == 0, (
+            "ragged prefill recompiled post-steady — the packed grid "
+            "capacities must all be compiled at warmup()"
+        )
+        ttfts = [(r.first_token_t - r.submit_t) * 1e3 for r in reqs]
+        m = engine.metrics()
+        streams = [r.result() for r in reqs]
+        return {
+            "ttft_p50_ms": round(float(np.median(ttfts)), 2),
+            "pad_waste": round(m.get("serving/prefill_pad_waste_frac", 0.0), 4),
+            "packed_tokens": m.get("serving/prefill_packed_tokens", 0),
+            "kernel_active": bool(m.get("serving/prefill_kernel_active")),
+            "paths": {r.prefill_kernel for r in reqs},
+            "streams": streams,
+        }
+
+    if on_tpu:
+        kernel_wave = wave(None)          # default dispatch -> ragged
+        dense_wave = wave("dense")
+        out["prefill_ttft_p50_ms"] = kernel_wave["ttft_p50_ms"]
+        out["prefill_ttft_p50_ms_dense"] = dense_wave["ttft_p50_ms"]
+        out["prefill_packed_tokens"] = kernel_wave["packed_tokens"]
+        out["prefill_pad_waste_frac"] = kernel_wave["pad_waste"]
+        out["prefill_pad_waste_frac_dense"] = dense_wave["pad_waste"]
+        # same generated LENGTH (greedy, no eos); token equality is the
+        # interpret-mode test suite's contract, not real-HW numerics'
+        assert ([len(s) for s in kernel_wave["streams"]]
+                == [len(s) for s in dense_wave["streams"]])
+        if not kernel_wave["kernel_active"]:
+            # pallas missing from this TPU build: both waves ran bucketed
+            out["prefill_kernel_speedup"] = None
+            out["prefill_kernel_active"] = False
+            return out
+        assert kernel_wave["paths"] == {"ragged"}, kernel_wave["paths"]
+        out["prefill_kernel_active"] = True
+        speedup = dense_wave["ttft_p50_ms"] / kernel_wave["ttft_p50_ms"]
+        assert speedup >= 1.0, (
+            f"ragged prefill kernel TTFT p50 {kernel_wave['ttft_p50_ms']}ms "
+            f"lost to the bucketed chunk path {dense_wave['ttft_p50_ms']}ms "
+            "on the mixed burst — the packed dispatch must not regress TTFT"
+        )
+        out["prefill_kernel_speedup"] = round(speedup, 2)
+        assert kernel_wave["pad_waste"] < dense_wave["pad_waste"], (
+            kernel_wave["pad_waste"], dense_wave["pad_waste"]
+        )
+    else:
+        kernel_wave = wave("interpret")   # the IDENTICAL kernel, interpreted
+        dense_wave = wave("dense")
+        out["prefill_ttft_p50_ms"] = dense_wave["ttft_p50_ms"]
+        out["prefill_packed_tokens"] = kernel_wave["packed_tokens"]
+        out["prefill_pad_waste_frac"] = kernel_wave["pad_waste"]
+        out["prefill_pad_waste_frac_dense"] = dense_wave["pad_waste"]
+        out["prefill_kernel_speedup"] = None  # compiled kernel is TPU-only
+        assert kernel_wave["kernel_active"] and kernel_wave["paths"] == {"ragged"}
+        # parity witness: the packed interpret wave's tokens must equal
+        # the bucketed dense wave's, token for token (greedy + exact)
+        for a, b in zip(kernel_wave["streams"], dense_wave["streams"]):
+            np.testing.assert_array_equal(a, b)
+        out["prefill_kernel_parity"] = True
+        assert kernel_wave["pad_waste"] < dense_wave["pad_waste"], (
+            kernel_wave["pad_waste"], dense_wave["pad_waste"]
+        )
+    return out
+
+
 def _serving_kv_quant_bench(cfg, prompt_len, *, page_size=16, flat_slots=4,
                             max_new=16, steps_per_call=4):
     """Quantized KV-arena rows (serving/drift.py harness + the int8 paged
@@ -1256,8 +1405,9 @@ def _decode_block_autotune(cfg, *, length=None, iters=30):
     machinery and the published shape are identical, but interpret-mode
     walls measure the interpreter, so `best_block` is only meaningful on
     hardware (tagged via `compiled`). head_dim configs failing the
-    kernel's 128-multiple shape gate report `gated: true` and sweep
-    nothing — the head_dim-64 path stays dense by design."""
+    kernel's 64-multiple shape gate report `gated: true` and sweep
+    nothing (PR 18 widened the gate from 128-multiples: the lane dim
+    pads 64→128 in VMEM, trading ~2x pad for kernel arithmetic)."""
     import dataclasses
 
     from accelerate_tpu.ops.attention import decode_attention
@@ -1266,11 +1416,11 @@ def _decode_block_autotune(cfg, *, length=None, iters=30):
     d = int(cfg.head_dim or (cfg.embed_dim // cfg.num_heads))
     L = int(length or min(cfg.max_seq_len, 2048 if on_tpu else 128))
     out = {"head_dim": d, "length": L, "compiled": bool(on_tpu)}
-    if on_tpu and d % 128:
+    if on_tpu and d % 64:
         out["gated"] = True
         out["gate_reason"] = (
-            f"head_dim {d} is not a 128-multiple; the compiled kernel "
-            "falls back dense (retune on a 128-multiple config)"
+            f"head_dim {d} is not a 64-multiple; the compiled kernel "
+            "falls back dense (retune on a 64-multiple config)"
         )
         return out
     kvh = int(cfg.num_kv_heads or cfg.num_heads)
@@ -1307,6 +1457,83 @@ def _decode_block_autotune(cfg, *, length=None, iters=30):
         walls[str(blk)] = round(1e3 * (time.perf_counter() - t0) / iters, 4)
     out["block_ms"] = walls
     out["best_block"] = int(min(walls, key=walls.get)) if walls else None
+    return out
+
+
+def _prefill_block_autotune(cfg, *, iters=20):
+    """`--tune-kernel-blocks`: sweep the ragged prefill kernel's
+    ``prefill_kernel_block`` (the packed token-block — one grid row-tile
+    per block) against the arena page size (the kv-block the prefix
+    sweep walks) and publish the wall grid plus the winners
+    (`best_prefill_block`, `best_prefill_kv_page`), the prefill twin of
+    `_decode_block_autotune`'s `best_block`. Same caveats: on TPU the
+    sweep times the COMPILED kernel; off-TPU it times the interpreter,
+    so the winners only mean anything on hardware (tagged `compiled`).
+    The workload is two packed admissions splitting the grid — one
+    resuming a prefix-cache hit (so the page-block skip phase sweeps
+    real pages), one cold — the mixed shape the serving packer emits."""
+    from accelerate_tpu.ops.attention import ragged_prefill_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    d = int(cfg.head_dim or (cfg.embed_dim // cfg.num_heads))
+    out = {"head_dim": d, "compiled": bool(on_tpu)}
+    if on_tpu and d % 64:
+        out["gated"] = True
+        out["gate_reason"] = (
+            f"head_dim {d} is not a 64-multiple; the prefill kernel "
+            "falls back to bucketed chunks (retune on a 64-multiple config)"
+        )
+        return out
+    h = int(cfg.num_heads)
+    kvh = int(cfg.num_kv_heads or cfg.num_heads)
+    cap = 512 if on_tpu else 32
+    iters = iters if on_tpu else 2
+    bt_cands = (8, 16, 32, 64, 128) if on_tpu else (8, 16)
+    ps_cands = (8, 16, 32) if on_tpu else (8,)
+    impl = None if on_tpu else "interpret"
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.standard_normal((1, h, cap, d)), dt)
+    k_new = jnp.asarray(rng.standard_normal((1, kvh, cap, d)), dt)
+    v_new = jnp.asarray(rng.standard_normal((1, kvh, cap, d)), dt)
+    half = hist = cap // 2
+    row_slot = jnp.asarray([0] * half + [1] * half, jnp.int32)
+    row_pos = jnp.asarray(
+        list(range(hist, hist + half)) + list(range(half)), jnp.int32
+    )
+    slot_hist = jnp.asarray([hist, 0], jnp.int32)
+    out["length"] = cap
+    walls = {}
+    for ps in ps_cands:
+        per = -(-(hist + half) // ps)
+        table = jnp.asarray(np.arange(2 * per, dtype=np.int32).reshape(2, per))
+        k_pages = jnp.asarray(rng.standard_normal((2 * per + 1, kvh, ps, d)), dt)
+        v_pages = jnp.asarray(rng.standard_normal((2 * per + 1, kvh, ps, d)), dt)
+        for bt in bt_cands:
+            fn = jax.jit(functools.partial(
+                ragged_prefill_attention, impl=impl, token_block=bt
+            ))
+
+            def force(r):
+                # same device_get discipline as the decode sweep
+                float(jax.device_get(r[0][0, 0, 0, 0]))
+
+            kw = dict(page_table=table, row_slot=row_slot, row_pos=row_pos,
+                      slot_hist=slot_hist)
+            force(fn(q, k_new, v_new, k_pages, v_pages, **kw))  # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = fn(q, k_new, v_new, k_pages, v_pages, **kw)
+            force(r)
+            walls[f"tb{bt}/page{ps}"] = round(
+                1e3 * (time.perf_counter() - t0) / iters, 4
+            )
+    out["block_ms"] = walls
+    if walls:
+        best = min(walls, key=walls.get)
+        tb, ps = best.split("/")
+        out["best_prefill_block"] = int(tb[2:])
+        out["best_prefill_kv_page"] = int(ps[4:])
     return out
 
 
@@ -1913,6 +2140,11 @@ def main():
                              "decode kernel and publish per-block walls + the "
                              "winner (meaningful on real TPU; CPU runs the "
                              "interpreter to prove the machinery)")
+    parser.add_argument("--tune-kernel-blocks", action="store_true",
+                        help="superset of --tune-decode-block: also sweep the "
+                             "ragged prefill kernel's token-block x kv-page "
+                             "grid and publish best_prefill_block beside "
+                             "best_block (same real-TPU caveat)")
     parser.add_argument("--telemetry-out", default=None, metavar="PATH",
                         help="write the headline train bench's per-step runtime-"
                              "telemetry records (step wall, tokens/s, live MFU) "
@@ -2105,8 +2337,13 @@ def main():
                     "decode_int8_kv_tokens_per_sec"):
             extra[key] = extra["serving_kv_quant"][key]
 
-        if args.tune_decode_block:
+        if args.tune_decode_block or args.tune_kernel_blocks:
             extra["decode_block_autotune"] = _decode_block_autotune(ttft_cfg)
+        if args.tune_kernel_blocks:
+            extra["prefill_block_autotune"] = _prefill_block_autotune(ttft_cfg)
+            extra["best_prefill_block"] = (
+                extra["prefill_block_autotune"].get("best_prefill_block")
+            )
 
         # ragged-occupancy decode: the pallas paged kernel vs the gathered
         # masked-dense read at 75% short / 25% long slots (asserted >= 1x)
@@ -2119,6 +2356,16 @@ def main():
         extra["decode_paged_kernel_speedup"] = (
             extra["serving_ragged"]["decode_paged_kernel_speedup"]
         )
+
+        # ragged prefill: the packed flash prefill kernel vs bucketed
+        # chunks on a mixed admission burst — TTFT speedup (asserted
+        # >= 1x when the kernel engages) + pad-waste comparison
+        extra["serving_prefill"] = _serving_prefill_bench(
+            ttft_cfg, 128, num_slots=8, page_size=64,
+        )
+        for key in ("prefill_kernel_speedup", "prefill_pad_waste_frac",
+                    "prefill_ttft_p50_ms"):
+            extra[key] = extra["serving_prefill"].get(key)
 
         # multi-tenant isolation under a seeded prefill storm (scheduler):
         # tenant B's ITL p99 clean vs under-storm, preempt/shed actions
@@ -2256,9 +2503,16 @@ def main():
                     "kv_quant_token_match_rate",
                     "decode_int8_kv_tokens_per_sec"):
             extra[key] = extra["serving_kv_quant"][key]
-        if args.tune_decode_block:
+        if args.tune_decode_block or args.tune_kernel_blocks:
             extra["decode_block_autotune"] = _decode_block_autotune(
                 DecoderConfig.tiny(max_seq_len=256)
+            )
+        if args.tune_kernel_blocks:
+            extra["prefill_block_autotune"] = _prefill_block_autotune(
+                DecoderConfig.tiny(max_seq_len=256)
+            )
+            extra["best_prefill_block"] = (
+                extra["prefill_block_autotune"].get("best_prefill_block")
             )
         extra["serving_ragged"] = _serving_ragged_bench(
             DecoderConfig.tiny(max_seq_len=256), 32, num_slots=4,
@@ -2270,6 +2524,16 @@ def main():
         extra["decode_paged_kernel_speedup"] = (
             extra["serving_ragged"]["decode_paged_kernel_speedup"]
         )
+        # ragged prefill witness, CPU-sized: interpret-vs-dense token
+        # parity + the pad-waste comparison (the packer runs identically
+        # under the interpreter; the compiled speedup row is TPU-only)
+        extra["serving_prefill"] = _serving_prefill_bench(
+            DecoderConfig.tiny(max_seq_len=256), 32, num_slots=4,
+            page_size=8, max_new=8,
+        )
+        for key in ("prefill_kernel_speedup", "prefill_pad_waste_frac",
+                    "prefill_kernel_parity", "prefill_ttft_p50_ms"):
+            extra[key] = extra["serving_prefill"].get(key)
         extra["serving_isolation"] = _serving_isolation_bench(
             DecoderConfig.tiny(max_seq_len=256), 32, page_size=16,
             num_slots=2, storm_reqs=3, b_reqs=3, max_new=8,

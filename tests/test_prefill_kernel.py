@@ -1,0 +1,402 @@
+"""Ragged flash prefill kernel (ops/attention.py) + engine integration.
+
+Op-level contracts of record, run through the pallas interpreter on CPU
+(the compiled TPU path shares every line but the `interpret` flag):
+
+- the packed ragged kernel (online-softmax over arena prefix pages +
+  same-slot causal fresh blocks) matches the dense reference across every
+  packing edge — the all-pad warmup grid, 1-token tails, prefix
+  frontiers at page boundary -1/0/+1, one admission filling the whole
+  grid, a 75/25 short/long mix — for every GQA group size;
+- quantize-on-write emits the EXACT `utils.quantization.quantize_kv`
+  payload + scales (int8 and int4) in the same pass as attention;
+- pad rows are never observable: they output exactly zero and garbage in
+  foreign slots' pages cannot perturb a pack;
+- dispatch: `ATT_PREFILL_KERNEL`/`prefill_kernel` resolution, the
+  warn-once dense fallback off-TPU, `prefill_kernel_active` mirroring
+  the gate, config validation.
+
+Engine-level: token parity ragged-vs-chunked-vs-single-stream (prefix
+replay included — the block-skip phase runs against real cache state),
+the pad-waste/packed-token gauges, the zero-post-steady-recompile
+invariant, and the audit program set covering the new `ragged_prefill_*`
+entry points.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.ops.attention import (
+    _PREFILL_TOKEN_BLOCK,
+    prefill_kernel_active,
+    ragged_prefill_attention,
+    resolve_prefill_kernel,
+)
+
+ATOL = 2e-5  # fp32 interpreter vs XLA softmax: reassociation-level noise
+
+
+def _packed_case(rng, packs, *, h=4, kvh=2, d=16, ps=8, bt=8,
+                 quant_bits=0):
+    """Build one packed grid from ``packs`` = [(hist, tail), ...]: rows
+    of one slot contiguous and position-ordered, each pack padded up to a
+    token-block boundary (pads keep the slot id, pos = -1), per-slot
+    page tables position-ordered over disjoint live pages (page 0
+    parked), ``slot_hist[s]`` = live prefix tokens already in the arena."""
+    S = max(1, len(packs))
+    cap = max(bt, sum(-(-t // bt) * bt for _, t in packs))
+    row_slot = np.full((cap,), -1, np.int32)
+    row_pos = np.full((cap,), -1, np.int32)
+    slot_hist = np.zeros((S,), np.int32)
+    r = 0
+    per = max(1, max((-(-(hi + t) // ps) for hi, t in packs), default=1))
+    table = np.zeros((S, per), np.int32)
+    for s, (hist, tail) in enumerate(packs):
+        blocks = -(-tail // bt)
+        row_slot[r:r + blocks * bt] = s
+        row_pos[r:r + tail] = np.arange(hist, hist + tail)
+        r += blocks * bt
+        slot_hist[s] = hist
+        need = -(-(hist + tail) // ps)
+        table[s, :need] = 1 + s * per + np.arange(need)
+    npages = 1 + S * per
+    pd = d // 2 if quant_bits == 4 else d
+    if quant_bits:
+        qmax = 7 if quant_bits == 4 else 127
+        k_pages = rng.randint(-qmax, qmax + 1,
+                              (npages, kvh, ps, pd)).astype(np.int8)
+        v_pages = rng.randint(-qmax, qmax + 1,
+                              (npages, kvh, ps, pd)).astype(np.int8)
+        k_scale = (rng.random_sample((npages, kvh, ps, 1)) + 0.1).astype(
+            np.float32)
+        v_scale = (rng.random_sample((npages, kvh, ps, 1)) + 0.1).astype(
+            np.float32)
+    else:
+        k_pages = rng.standard_normal((npages, kvh, ps, pd)).astype(np.float32)
+        v_pages = rng.standard_normal((npages, kvh, ps, pd)).astype(np.float32)
+        k_scale = v_scale = None
+    q = rng.standard_normal((1, h, cap, d)).astype(np.float32)
+    k_new = rng.standard_normal((1, kvh, cap, d)).astype(np.float32)
+    v_new = rng.standard_normal((1, kvh, cap, d)).astype(np.float32)
+    kw = dict(page_table=jnp.asarray(table), row_slot=jnp.asarray(row_slot),
+              row_pos=jnp.asarray(row_pos), slot_hist=jnp.asarray(slot_hist),
+              token_block=bt, kv_quant_bits=quant_bits)
+    if quant_bits:
+        kw.update(k_scale=jnp.asarray(k_scale), v_scale=jnp.asarray(v_scale))
+    args = (jnp.asarray(q), jnp.asarray(k_new), jnp.asarray(v_new),
+            jnp.asarray(k_pages), jnp.asarray(v_pages))
+    valid = (row_slot >= 0) & (row_pos >= 0)
+    return args, kw, valid
+
+
+def _assert_kernel_matches_dense(args, kw, valid, err=""):
+    out_k = ragged_prefill_attention(*args, impl="interpret", **kw)
+    out_d = ragged_prefill_attention(*args, impl="dense", **kw)
+    np.testing.assert_allclose(
+        np.asarray(out_k[0])[0, :, valid], np.asarray(out_d[0])[0, :, valid],
+        atol=ATOL, rtol=1e-5, err_msg=err,
+    )
+    # pad rows exactly zero on BOTH paths — the engine's fused scatter
+    # routes them at the parking page, but nothing may leak through them
+    np.testing.assert_array_equal(np.asarray(out_k[0])[0, :, ~valid], 0.0)
+    np.testing.assert_array_equal(np.asarray(out_d[0])[0, :, ~valid], 0.0)
+    return out_k, out_d
+
+
+class TestRaggedPackingEdges:
+    def test_all_pad_grid_empty_tail(self):
+        """The warmup shape: every row padded (slot -1). Output is exactly
+        zero — a pure-cache-hit admission that packed nothing real must
+        not read anything."""
+        rng = np.random.RandomState(0)
+        args, kw, valid = _packed_case(rng, [])
+        assert not valid.any()
+        _assert_kernel_matches_dense(args, kw, valid)
+
+    @pytest.mark.parametrize("hist", [0, 10])
+    def test_one_token_tail(self, hist):
+        """A 1-token tail (the prefix-hit resume shape: everything but
+        the last prompt token served from cache) — one real row, bt-1
+        pads."""
+        rng = np.random.RandomState(1)
+        args, kw, valid = _packed_case(rng, [(hist, 1)])
+        assert valid.sum() == 1
+        _assert_kernel_matches_dense(args, kw, valid, f"hist={hist}")
+
+    @pytest.mark.parametrize("hist", [7, 8, 9])
+    def test_prefix_frontier_page_boundary(self, hist):
+        """Prefix history ending at page boundary -1/0/+1 (ps=8): the
+        block-skip phase must stop at ceil(hist/ps) pages and the
+        partial-page frontier is masked by position, not page count."""
+        rng = np.random.RandomState(2)
+        args, kw, valid = _packed_case(rng, [(hist, 8)])
+        _assert_kernel_matches_dense(args, kw, valid, f"hist={hist}")
+
+    def test_single_admission_fills_grid(self):
+        rng = np.random.RandomState(3)
+        args, kw, valid = _packed_case(rng, [(0, 32)])
+        assert valid.all()
+        _assert_kernel_matches_dense(args, kw, valid)
+
+    def test_mixed_75_25_pack(self):
+        """The serving packer's target mix: one long resumed tail plus
+        three short cold tails in a single grid."""
+        rng = np.random.RandomState(4)
+        args, kw, valid = _packed_case(
+            rng, [(16, 21), (0, 7), (0, 8), (0, 5)]
+        )
+        _assert_kernel_matches_dense(args, kw, valid)
+
+    @pytest.mark.parametrize("h,kvh", [(4, 4), (4, 2), (4, 1)])
+    def test_gqa_group_sizes(self, h, kvh):
+        rng = np.random.RandomState(5)
+        args, kw, valid = _packed_case(rng, [(10, 11), (0, 9)],
+                                       h=h, kvh=kvh)
+        _assert_kernel_matches_dense(args, kw, valid, f"gqa {h}/{kvh}")
+
+    def test_foreign_pages_never_observable(self):
+        """Garbage in the parking page and in OTHER slots' pages cannot
+        perturb a pack: the same-slot guard + table walk never touch
+        them."""
+        rng = np.random.RandomState(6)
+        args, kw, valid = _packed_case(rng, [(10, 6), (0, 8)])
+        out_clean = ragged_prefill_attention(*args, impl="interpret", **kw)
+        q, k_new, v_new, kp, vp = args
+        table = np.asarray(kw["page_table"])
+        big = 1e6  # finite garbage: NaN poisons even the dense reference
+        touched = set(table[0, :2]) | {0}  # slot 0's live prefix + parking
+        for pg in range(kp.shape[0]):
+            if pg not in touched:
+                kp = kp.at[pg].set(big)
+                vp = vp.at[pg].set(-big)
+        kp = kp.at[0].set(big)
+        vp = vp.at[0].set(-big)
+        out_garbage = ragged_prefill_attention(
+            q, k_new, v_new, kp, vp, impl="interpret", **kw
+        )
+        np.testing.assert_array_equal(np.asarray(out_clean[0]),
+                                      np.asarray(out_garbage[0]))
+
+
+class TestQuantizeOnWrite:
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_payload_matches_quantize_kv(self, bits):
+        """Fused quantize-on-write (one pass with attention) emits the
+        EXACT reference `quantize_kv` payload and scales, and interpret
+        == dense bitwise on both."""
+        from accelerate_tpu.utils.quantization import quantize_kv
+
+        rng = np.random.RandomState(7)
+        args, kw, valid = _packed_case(rng, [(10, 11), (0, 9)],
+                                       quant_bits=bits)
+        out_k, out_d = _assert_kernel_matches_dense(args, kw, valid)
+        _, kp_k, ks_k, vp_k, vs_k = out_k
+        _, kp_d, ks_d, vp_d, vs_d = out_d
+        np.testing.assert_array_equal(np.asarray(kp_k), np.asarray(kp_d))
+        np.testing.assert_array_equal(np.asarray(vp_k), np.asarray(vp_d))
+        np.testing.assert_allclose(np.asarray(ks_k), np.asarray(ks_d),
+                                   atol=1e-7)
+        np.testing.assert_allclose(np.asarray(vs_k), np.asarray(vs_d),
+                                   atol=1e-7)
+        k_new, v_new = args[1], args[2]
+        for got_p, got_s, src in ((kp_k, ks_k, k_new), (vp_k, vs_k, v_new)):
+            ref_p, ref_s = quantize_kv(jnp.swapaxes(src[0], 0, 1), bits)
+            np.testing.assert_array_equal(np.asarray(got_p),
+                                          np.asarray(ref_p))
+            np.testing.assert_allclose(np.asarray(got_s), np.asarray(ref_s),
+                                       atol=1e-7)
+
+    def test_unquantized_returns_no_scales(self):
+        rng = np.random.RandomState(8)
+        args, kw, valid = _packed_case(rng, [(0, 8)])
+        out = ragged_prefill_attention(*args, impl="interpret", **kw)
+        assert out[2] is None and out[4] is None
+
+
+class TestPrefillDispatch:
+    def test_resolution_order_and_validation(self, monkeypatch):
+        monkeypatch.delenv("ATT_PREFILL_KERNEL", raising=False)
+        assert resolve_prefill_kernel() == "ragged"
+        assert resolve_prefill_kernel("dense") == "dense"
+        monkeypatch.setenv("ATT_PREFILL_KERNEL", "dense")
+        assert resolve_prefill_kernel() == "dense"
+        assert resolve_prefill_kernel("interpret") == "interpret"  # arg wins
+        with pytest.raises(ValueError):
+            resolve_prefill_kernel("flash")
+
+    def test_warn_once_dense_fallback_off_tpu(self, caplog):
+        from accelerate_tpu.ops import attention as A
+
+        rng = np.random.RandomState(9)
+        args, kw, valid = _packed_case(rng, [(0, 8)])
+        A._decode_fallback_warned -= {
+            k for k in A._decode_fallback_warned if k.startswith("prefill:")
+        }
+        with caplog.at_level(logging.WARNING, logger=A.__name__):
+            out = ragged_prefill_attention(*args, impl="ragged", **kw)
+            again = ragged_prefill_attention(*args, impl="ragged", **kw)
+        warns = [r for r in caplog.records
+                 if "ragged prefill kernel unavailable" in r.getMessage()]
+        assert len(warns) == 1, [r.getMessage() for r in caplog.records]
+        ref = ragged_prefill_attention(*args, impl="dense", **kw)
+        np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+        np.testing.assert_array_equal(np.asarray(again[0]),
+                                      np.asarray(ref[0]))
+
+    def test_prefill_kernel_active_mirrors_gate(self):
+        from accelerate_tpu.models import DecoderConfig
+
+        paged = dict(max_seq_len=64, kv_page_size=8, kv_num_pages=17)
+        assert prefill_kernel_active(
+            DecoderConfig.tiny(prefill_kernel="interpret", **paged)
+        )
+        assert not prefill_kernel_active(
+            DecoderConfig.tiny(prefill_kernel="dense", **paged)
+        )
+        # CPU process: the default compiled mode falls back to chunks
+        assert not prefill_kernel_active(DecoderConfig.tiny(**paged))
+        # unpaged config: no arena, no packed dispatch
+        assert not prefill_kernel_active(
+            DecoderConfig.tiny(max_seq_len=64, prefill_kernel="interpret")
+        )
+
+    def test_config_validation(self):
+        from accelerate_tpu.models import DecoderConfig
+
+        with pytest.raises(ValueError, match="prefill_kernel"):
+            DecoderConfig.tiny(prefill_kernel="flash")
+        with pytest.raises(ValueError, match="prefill_kernel_block"):
+            DecoderConfig.tiny(prefill_kernel_block=-8)
+
+
+@pytest.fixture(scope="module")
+def ragged_models():
+    """One parameter set served by three model views: ragged-interpret,
+    forced-dense, and the plain single-stream reference."""
+    from accelerate_tpu.models import DecoderConfig, DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+
+    cfg_k = DecoderConfig.tiny(max_seq_len=64, prefill_kernel="interpret")
+    cfg_d = DecoderConfig.tiny(max_seq_len=64)
+    model_k, model_d = DecoderLM(cfg_k), DecoderLM(cfg_d)
+    variables = model_k.init_variables(jax.random.PRNGKey(0), batch_size=1,
+                                       seq_len=16)
+    params, _ = unbox_params(variables["params"])
+    return model_k, model_d, cfg_k, params
+
+
+ENG_KW = dict(num_slots=2, max_cache_len=64, prefill_chunks=(4, 8),
+              page_size=8)
+
+
+class TestEngineRaggedAdmission:
+    def test_token_parity_and_gauges(self, ragged_models):
+        """Ragged engine == chunked engine == single-stream generate(),
+        token for token, over mixed prompt lengths — then the telemetry
+        spine: packed-token / pad-waste / kernel-active gauges and the
+        zero-post-steady-recompile invariant."""
+        from accelerate_tpu.generation import generate
+        from accelerate_tpu.serving import ServingEngine
+
+        model_k, model_d, _, params = ragged_models
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(3, 16, (n,)) for n in (5, 8, 12, 3)]
+        refs = [
+            np.asarray(generate(model_d, params, p[None], max_new_tokens=6,
+                                rng=jax.random.PRNGKey(i))[0])
+            for i, p in enumerate(prompts)
+        ]
+        eng_d = ServingEngine(model_d, params, **ENG_KW)
+        assert eng_d._ragged_prefill is False
+        outs_d = eng_d.generate_batched(prompts, max_new_tokens=6)
+        eng_k = ServingEngine(model_k, params, **ENG_KW)
+        assert eng_k._ragged_prefill is True
+        eng_k.warmup()
+        eng_k.mark_steady()
+        reqs = [eng_k.submit(p, max_new_tokens=6, seed=i)
+                for i, p in enumerate(prompts)]
+        eng_k.run()
+        outs_k = [r.result() for r in reqs]
+        for out_k, out_d, ref in zip(outs_k, outs_d, refs):
+            np.testing.assert_array_equal(out_d, ref)
+            np.testing.assert_array_equal(out_k, ref)
+        m = eng_k.metrics()
+        assert m["serving/prefill_kernel_active"] is True
+        assert m["serving/prefill_packed_tokens"] == sum(
+            p.size for p in prompts
+        )
+        assert m["serving/admission_recompiles"] == 0
+        assert 0.0 <= m["serving/prefill_pad_waste_frac"] < 1.0
+        assert eng_d.metrics()["serving/prefill_kernel_active"] is False
+        # the per-request record names the path that admitted it — what
+        # the TTFT waterfall's kernel-vs-dense annotation reads
+        assert {r.prefill_kernel for r in reqs} == {"ragged"}
+
+    def test_co_admission_packs_queued_tails(self, ragged_models):
+        """More queued admissions than one tail: the planner packs whole
+        queued tails into the primary's grid (FIFO engines only) and the
+        pad-waste gauge beats the bucketed path's on short bursts."""
+        from accelerate_tpu.serving import ServingEngine
+
+        model_k, model_d, _, params = ragged_models
+        rng = np.random.RandomState(1)
+        short = [rng.randint(3, 16, (5,)) for _ in range(4)]
+        kw = dict(num_slots=4, max_cache_len=64, prefill_chunks=(16,),
+                  page_size=8)
+        ed = ServingEngine(model_d, params, **kw)
+        od = ed.generate_batched(short, max_new_tokens=4)
+        # dense wave first: the recompile counter is process-global, so
+        # nothing may compile between mark_steady() and the assert
+        ek = ServingEngine(model_k, params, **kw)
+        ek.warmup()
+        ek.mark_steady()
+        ok = ek.generate_batched(short, max_new_tokens=4)
+        for a, b in zip(ok, od):
+            np.testing.assert_array_equal(a, b)
+        assert ek.admission_recompiles == 0
+        waste_k = ek.metrics()["serving/prefill_pad_waste_frac"]
+        waste_d = ed.metrics()["serving/prefill_pad_waste_frac"]
+        assert waste_k < waste_d, (waste_k, waste_d)
+
+    def test_prefix_skip_replay_matches_chunked(self, ragged_models):
+        """Prefix-cache replay: the resubmitted prompt admits with a
+        live arena prefix, so the kernel's block-skip phase runs against
+        real cache state — tokens must equal the chunked engine's."""
+        from accelerate_tpu.serving import ServingEngine
+
+        model_k, model_d, _, params = ragged_models
+        rng = np.random.RandomState(2)
+        prompt = rng.randint(3, 16, (12,))
+        outs = {}
+        for name, model in (("ragged", model_k), ("dense", model_d)):
+            eng = ServingEngine(model, params, **ENG_KW)
+            first = eng.generate_batched([prompt], max_new_tokens=6)
+            replay = eng.generate_batched([prompt], max_new_tokens=6)
+            np.testing.assert_array_equal(first[0], replay[0])
+            assert eng.metrics()["serving/prefix_hit_ratio"] > 0
+            outs[name] = replay[0]
+        np.testing.assert_array_equal(outs["ragged"], outs["dense"])
+
+    def test_audit_covers_ragged_programs(self, ragged_models):
+        """The warmup program set enumerates every packed-grid capacity
+        as `ragged_prefill_<cap>` and the full engine audit (donation on,
+        trace-only) stays clean — the CI `audit` gate needs no new
+        baseline entries for the kernel."""
+        from accelerate_tpu.analysis import program_audit as pa
+        from accelerate_tpu.serving import ServingEngine
+
+        model_k, _, _, params = ragged_models
+        eng = ServingEngine(model_k, params, donate=True, num_slots=2,
+                            max_cache_len=64, prefill_chunks=(8, 16),
+                            page_size=8)
+        eng.warmup()
+        names = {pa.EntrypointSpec.normalize(s).name
+                 for s in eng.audit_entrypoints()}
+        assert {"ragged_prefill_8", "ragged_prefill_16"} <= names, names
+        fs = pa.audit_engine(eng)
+        assert fs == [], [f.to_dict() for f in fs]
