@@ -51,6 +51,7 @@ JAX_FREE_MODULES = (
     "accelerate_tpu.telemetry.canary",
     "accelerate_tpu.telemetry.waterfall",
     "accelerate_tpu.telemetry.scorecard",
+    "accelerate_tpu.telemetry.capacity",
     "accelerate_tpu.serving.pages",
     "accelerate_tpu.serving.tiers",
     "accelerate_tpu.serving.scheduler",
@@ -58,12 +59,14 @@ JAX_FREE_MODULES = (
     "accelerate_tpu.serving.router",
     "accelerate_tpu.serving.replica_server",
     "accelerate_tpu.serving.loadgen",
+    "accelerate_tpu.serving.autoscaler",
     "accelerate_tpu.commands.trace",
     "accelerate_tpu.commands.report",
     "accelerate_tpu.commands.watch",
     "accelerate_tpu.commands.audit",
     "accelerate_tpu.commands.serve",
     "accelerate_tpu.commands.loadtest",
+    "accelerate_tpu.commands.autoscale",
     "accelerate_tpu.analysis",
     "accelerate_tpu.analysis.findings",
     "accelerate_tpu.analysis.hygiene",

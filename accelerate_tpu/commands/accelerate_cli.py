@@ -16,7 +16,7 @@ import sys
 
 _COMMANDS = (
     "config", "launch", "estimate", "merge", "test", "tpu_config",
-    "trace", "report", "watch", "audit", "serve", "loadtest",
+    "trace", "report", "watch", "audit", "serve", "loadtest", "autoscale",
 )
 
 

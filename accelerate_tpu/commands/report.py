@@ -208,6 +208,7 @@ NOTABLE_TIMELINE_KEYS = (
     "serving/tokens_per_s", "serving/itl_recent_p99_ms",
     "serving/ttft_p99_ms", "serving/queue_depth", "serving/slot_occupancy",
     "serving/pages_in_use", "serving/shed", "goodput/goodput_frac",
+    "serving/capacity_tokens_per_s", "serving/headroom_frac",
     "sys/tokens_per_s", "sys/mfu_pct", "alerts/firing_count",
 )
 
@@ -327,6 +328,43 @@ def load_audit(target: str) -> dict:
     return {}
 
 
+def load_autoscale_summary(target: str) -> dict:
+    """Autoscaler decision history out of ``autoscale-decisions.jsonl``:
+    counts by action and outcome, reaction times (burn-rule firing →
+    first verified token on the new replica), the scale-in conservation
+    verdicts, and the recent decisions with their stage decomposition."""
+    if not _host_files(target, "autoscale-decisions.jsonl"):
+        return {}
+    from ..serving.autoscaler import load_autoscale_decisions
+
+    records = load_autoscale_decisions(target)
+    if not records:
+        return {}
+    actions: dict = {}
+    outcomes: dict = {}
+    for r in records:
+        act = str(r.get("action"))
+        actions[act] = actions.get(act, 0) + 1
+        out = r.get("outcome")
+        if out:
+            outcomes[str(out)] = outcomes.get(str(out), 0) + 1
+    reactions = [r["autoscale_reaction_s"] for r in records
+                 if isinstance(r.get("autoscale_reaction_s"), (int, float))]
+    not_conserved = sum(
+        1 for r in records
+        if (r.get("ledger") or {}).get("conserved") is False
+    )
+    return {
+        "decisions": len(records),
+        "actions": actions,
+        "outcomes": outcomes,
+        "reaction_s_last": round(reactions[-1], 4) if reactions else None,
+        "reaction_s_max": round(max(reactions), 4) if reactions else None,
+        "scale_ins_not_conserved": not_conserved,
+        "recent": records[-8:],
+    }
+
+
 def load_loadtest_scorecard(target: str) -> dict:
     """The SLO scorecard (``loadtest-scorecard.json`` written by
     ``accelerate-tpu loadtest --out DIR``): attainment per tenant and
@@ -354,6 +392,7 @@ def load_report(target: str) -> dict:
         "fleet": load_fleet_summary(target),
         "waterfall": load_waterfall_summary(target),
         "canary": load_canary_summary(target),
+        "autoscale": load_autoscale_summary(target),
         "audit": load_audit(target),
         "loadtest": load_loadtest_scorecard(target),
     }
@@ -573,6 +612,37 @@ def format_report(data: dict) -> str:
                 f"{last.get('replica')} ({last.get('reason', '?')})"
             )
 
+    a = data.get("autoscale") or {}
+    if a.get("decisions"):
+        acts = a.get("actions") or {}
+        lines.append("")
+        lines.append(
+            f"autoscale: {a['decisions']} decision(s) — "
+            f"{acts.get('scale_out', 0)} out, {acts.get('scale_in', 0)} in, "
+            f"{acts.get('hold', 0)} held"
+            + (f"; reaction last/max = {a['reaction_s_last']}/"
+               f"{a['reaction_s_max']} s"
+               if a.get("reaction_s_last") is not None else "")
+        )
+        if a.get("scale_ins_not_conserved"):
+            lines.append(
+                f"  [NOT CONSERVED] {a['scale_ins_not_conserved']} "
+                "scale-in(s) lost requests across the membership change"
+            )
+        for rec in (a.get("recent") or [])[-6:]:
+            stages = rec.get("stages") or {}
+            stage_txt = " ".join(
+                f"{k.replace('_s', '')}={v:.2f}s" for k, v in stages.items()
+                if isinstance(v, (int, float))
+            )
+            lines.append(
+                f"  @{rec.get('t_unix_s', 0):.0f} {rec.get('action')}"
+                + (f" {rec.get('replica')}" if rec.get("replica") else "")
+                + f" [{rec.get('outcome') or rec.get('reason', '?')}]"
+                + f" ({rec.get('reason', '?')})"
+                + (f"  {stage_txt}" if stage_txt else "")
+            )
+
     card = data.get("loadtest") or {}
     if card:
         from ..telemetry.scorecard import format_scorecard
@@ -715,6 +785,19 @@ def collect_diff_metrics(target: str) -> dict:
     canary = data.get("canary") or {}
     if isinstance(canary.get("pass_ratio"), (int, float)):
         out["canary_pass_ratio"] = float(canary["pass_ratio"])
+    # the closed-loop signals: scale action counts and the reaction time
+    # (burn firing -> first verified token on the new replica) — a round
+    # where reaction_s grew names the actuation path, and any scale-in
+    # that broke conservation is a correctness regression outright
+    autoscale = data.get("autoscale") or {}
+    if autoscale:
+        acts = autoscale.get("actions") or {}
+        out["autoscale/scale_outs"] = float(acts.get("scale_out", 0))
+        out["autoscale/scale_ins"] = float(acts.get("scale_in", 0))
+        for field in ("reaction_s_last", "reaction_s_max",
+                      "scale_ins_not_conserved"):
+            if isinstance(autoscale.get(field), (int, float)):
+                out[f"autoscale/{field}"] = float(autoscale[field])
     # the replay-plane regression signals: fleet attainment/goodput plus
     # per-tenant attainment — a tenant whose SLO slipped between rounds
     # names itself even when the fleet number holds (mix shift)

@@ -257,6 +257,8 @@ def _build_frame(target: str, history: dict, span_s: float) -> dict:
 
 FLEET_SERIES = (
     "serving/tokens_per_s",
+    "serving/capacity_tokens_per_s",
+    "serving/headroom_frac",
     "serving/itl_p99_ms",
     "serving/queue_depth",
     "serving/pages_in_use",
@@ -308,6 +310,7 @@ def render_fleet_frame(collector, series_keys, width: int = 32,
                 f"{sparkline(hist, width)}  "
                 f"[{_fmt_num(min(hist))} .. {_fmt_num(max(hist))}]"
             )
+    lines.extend(_capacity_section(collector))
     lines.extend(_router_section(collector, width=width, span_s=span_s))
     lines.extend(_cache_economics_section(collector))
     states = collector.alerts.states_snapshot()
@@ -333,6 +336,42 @@ def render_fleet_frame(collector, series_keys, width: int = 32,
 
 
 ROUTER_SERIES = ("router/inflight", "serving/queue_depth")
+
+
+def _capacity_section(collector) -> list:
+    """The offered-vs-capacity block of a fleet frame — present once any
+    replica exports the capacity gauges (``telemetry/capacity.py``):
+    fleet capacity (sums over live replicas), offered rate against it,
+    and — when an autoscaler publishes through a scraped router — the
+    daemon's own counters and last reaction time."""
+    from ..telemetry.capacity import fleet_capacity
+
+    gauges = collector.fleet_gauges()
+    row = fleet_capacity(gauges)
+    lines = []
+    if row is not None:
+        lines.extend(["", (
+            "  capacity: "
+            f"offered {_fmt_num(row['offered_tokens_per_s'])} / "
+            f"{_fmt_num(row['capacity_tokens_per_s'])} tok/s"
+            f" · utilization {_fmt_num(row['utilization_frac'])}"
+            f" · headroom {_fmt_num(row['headroom_frac'])}"
+        )])
+    evals = gauges.get("autoscale/evals")
+    if evals:
+        reaction = gauges.get("autoscale/last_reaction_s")
+        if not lines:
+            lines.append("")
+        lines.append(
+            "  autoscale: "
+            f"{_fmt_num(gauges.get('autoscale/scale_outs'))} out / "
+            f"{_fmt_num(gauges.get('autoscale/scale_ins'))} in over "
+            f"{_fmt_num(evals)} evals"
+            f" · owned {_fmt_num(gauges.get('autoscale/replicas_owned'))}"
+            + (f" · last reaction {_fmt_num(reaction)}s"
+               if reaction is not None else "")
+        )
+    return lines
 
 
 def _cache_economics_section(collector) -> list:

@@ -536,6 +536,7 @@ class ServingEngine:
         self._counters = compile_event_counters
         self._steady_mark = None
         self._exe_mem: Optional[dict] = None
+        self._capacity_model = None  # lazy CapacityModel (metrics())
 
         if telemetry is None:
             from ..telemetry import current_session
@@ -2937,6 +2938,20 @@ class ServingEngine:
             ),
             draining=self._draining,
         )
+        # sustainable-rate estimate + headroom (telemetry/capacity.py):
+        # the autoscaler's scale decision inputs, fed the serving gauges
+        # above plus the roofline registry's decode-step attribution
+        from ..telemetry.capacity import CapacityModel
+
+        if self._capacity_model is None:
+            self._capacity_model = CapacityModel()
+        costs = getattr(self.telemetry, "costs", None)
+        if costs is not None:
+            cap_in = dict(out)
+            cap_in.update(costs.rollup_keys(probe=False))
+        else:
+            cap_in = out
+        out.update(self._capacity_model.observe(cap_in))
         return out
 
     @classmethod

@@ -19,8 +19,10 @@ Two driver shapes:
 
 - **open loop** arrivals ignore completions: Poisson (``expovariate``
   gaps at ``rate_rps``), bursty (``burst_size`` simultaneous arrivals
-  per gap), or a diurnal-style **ramp** (rate interpolates linearly
-  across the run — the saturation sweep's single-run cousin).
+  per gap), a **ramp** (rate interpolates linearly across the run — the
+  saturation sweep's single-run cousin), or **diurnal** (a sinusoid over
+  ``period_s`` modulating any of the other processes — the autoscaler
+  drill's traffic shape: load that swells past capacity and recedes).
 - **closed loop**: ``users`` concurrent users, each submitting its next
   request only after the previous finished plus a drawn think time —
   the arrival rate self-regulates to the service rate, which is what
@@ -49,6 +51,7 @@ from __future__ import annotations
 import hashlib
 import http.client
 import json
+import math
 import random
 import threading
 import time
@@ -112,8 +115,11 @@ class WorkloadSpec:
     seed: int = 0
     mode: str = "open"                 # open | closed
     num_requests: int = 64
-    #: open loop: {"process": "poisson"|"burst"|"ramp", "rate_rps": r,
-    #: "burst_size": k, "rate_rps_to": r2}
+    #: open loop: {"process": "poisson"|"burst"|"ramp"|"diurnal",
+    #: "rate_rps": r, "burst_size": k, "rate_rps_to": r2}; diurnal
+    #: modulates a "base" process ("poisson"|"burst"|"ramp", default
+    #: poisson) by 1 + amplitude*sin(2*pi*t/period_s), with
+    #: "period_s" (default 60) and "amplitude" in [0, 1) (default 0.5)
     arrival: dict = field(default_factory=lambda: {
         "process": "poisson", "rate_rps": 32.0,
     })
@@ -178,8 +184,11 @@ class ScheduledRequest:
         return f"lg{self.seed & 0xffff:04x}-{self.index}"
 
 
-def _arrival_gaps(rng: random.Random, arrival: dict, i: int, n: int) -> float:
-    """Gap before arrival-group ``i`` of ``n`` under the arrival spec."""
+def _arrival_gaps(rng: random.Random, arrival: dict, i: int, n: int,
+                  t: float = 0.0) -> float:
+    """Gap before arrival-group ``i`` of ``n`` under the arrival spec.
+    ``t`` is the schedule clock so far (schedule time, not wall time —
+    determinism holds); only ``diurnal`` reads it."""
     process = arrival.get("process", "poisson")
     rate = float(arrival.get("rate_rps", 32.0))
     if process == "poisson":
@@ -192,6 +201,19 @@ def _arrival_gaps(rng: random.Random, arrival: dict, i: int, n: int) -> float:
         r2 = float(arrival.get("rate_rps_to", rate * 4))
         frac = i / max(1, n - 1)
         return rng.expovariate(rate + (r2 - rate) * frac)
+    if process == "diurnal":
+        # sinusoidal rate modulation composed with a base process: the
+        # base draws its gap (identical rng consumption → composable
+        # determinism), then the gap stretches/compresses by the local
+        # rate multiplier at schedule time t
+        base = dict(arrival)
+        base["process"] = str(arrival.get("base", "poisson"))
+        if base["process"] == "diurnal":
+            raise ValueError("diurnal cannot compose with itself")
+        period = max(1e-6, float(arrival.get("period_s", 60.0)))
+        amp = min(0.99, max(0.0, float(arrival.get("amplitude", 0.5))))
+        mod = 1.0 + amp * math.sin(2.0 * math.pi * t / period)
+        return _arrival_gaps(rng, base, i, n) / max(1e-3, mod)
     raise ValueError(f"unknown arrival process {process!r}")
 
 
@@ -207,7 +229,8 @@ def build_schedule(spec: WorkloadSpec) -> list:
     group = 0
     user = 0
     while len(out) < spec.num_requests:
-        t_clock += _arrival_gaps(rng, spec.arrival, group, spec.num_requests)
+        t_clock += _arrival_gaps(rng, spec.arrival, group, spec.num_requests,
+                                 t=t_clock)
         tenant = rng.choices(spec.tenants, weights=weights)[0]
         turns = 1
         session = None

@@ -312,6 +312,7 @@ class Router:
                 self.hists[key] = StreamingHistogram()
         self.decisions: list = []   # bounded ring of placement decisions
         self.canary = None          # optional attached CanaryProber
+        self.autoscaler = None      # optional attached Autoscaler
         self._log_lock = threading.Lock()
         self._decisions_fh = None
         self._requests_fh = None
@@ -360,6 +361,11 @@ class Router:
         return self
 
     def close(self):
+        if self.autoscaler is not None:
+            try:
+                self.autoscaler.close()
+            except Exception:
+                pass
         if self.canary is not None:
             try:
                 self.canary.close()
@@ -879,6 +885,11 @@ class Router:
                 out.update(self.canary.rollup_keys())
             except Exception:
                 pass  # a sick prober must not fail the scrape
+        if self.autoscaler is not None:
+            try:
+                out.update(self.autoscaler.rollup_keys())
+            except Exception:
+                pass  # same contract as the prober
         return out
 
     def attach_canary(self, prober) -> "Router":
@@ -886,6 +897,13 @@ class Router:
         ``canary/*`` gauges through this router's ``/metrics`` (the
         prober's lifecycle joins ``close()``)."""
         self.canary = prober
+        return self
+
+    def attach_autoscaler(self, autoscaler) -> "Router":
+        """Publish an attached :class:`~.autoscaler.Autoscaler`'s
+        ``autoscale/*`` gauges through this router's ``/metrics`` (its
+        lifecycle joins ``close()``)."""
+        self.autoscaler = autoscaler
         return self
 
 

@@ -202,7 +202,7 @@ def parse_exposition(text: str) -> ExpositionSnapshot:
 # unflatten_key restores the namespace so fleet-timeline keys match the
 # per-replica rollup keys and AlertRule/BurnRateRule evaluate unchanged
 _NAMESPACES = ("serving", "usage", "goodput", "sys", "exe", "alerts",
-               "fleet", "train", "fp8", "router", "canary")
+               "fleet", "train", "fp8", "router", "canary", "autoscale")
 
 
 def unflatten_key(name: str) -> str:
@@ -595,15 +595,35 @@ class FleetCollector:
 
     def add_replica(self, name: str, target: str) -> None:
         """Register a replica mid-flight (elastic scale-out): it enters
-        the state machine at ``starting`` and joins the next poll. A
-        re-registration under the same name refreshes the target but
-        keeps the existing scrape history."""
+        the state machine at ``starting`` and joins the next poll —
+        *regardless of poll timing*. A re-registration under the same
+        name (scale-in then scale-out reusing the slot name) is a NEW
+        incarnation: status resets to a fresh ``starting`` with a fresh
+        ``registered_t``, so neither the old incarnation's terminal
+        state nor a scrape of the old process still in flight can make
+        the newcomer's first transition read ``unreachable``/``dead``
+        (``poll_once`` discards results whose ``registered_t`` predates
+        the re-registration). Cumulative scrape counters survive — they
+        count the name's lifetime, not the incarnation's."""
         name, target = str(name), str(target)
         now = self._clock()
         with self._lock:
             r = self.replicas.get(name)
             if r is not None:
+                if r.state != STARTING:
+                    self._transition(r, STARTING, now, "re-registered")
                 r.target = target
+                # fresh incarnation: the dead-deadline anchor restarts
+                # now, and stale last-known gauges leave the aggregate
+                r.registered_t = now
+                r.since = now
+                r.last_ok_t = None
+                r.last_err = None
+                r.consecutive_failures = 0
+                r.gauges = {}
+                r.histograms = {}
+                r.alerts = {}
+                r.sample_age_s = None
                 return
             self.replicas[name] = ReplicaStatus(
                 name=name, target=target, since=now, registered_t=now
@@ -761,10 +781,16 @@ class FleetCollector:
         # remove_replica), so the pass runs over a locked snapshot and
         # re-checks membership before folding each result back in.
         def one(r):
+            # registered_t is the incarnation stamp: fold-back discards
+            # this result if the name was re-registered (a NEW process
+            # behind the same name) while the scrape was in flight — a
+            # stale scrape must never become the newcomer's first
+            # transition
+            gen = r.registered_t
             try:
-                return (r.name, self._fetch(r.target), None)
+                return (r.name, gen, self._fetch(r.target), None)
             except Exception as e:
-                return (r.name, None, e)
+                return (r.name, gen, None, e)
 
         with self._lock:
             replicas = list(self.replicas.values())
@@ -782,10 +808,12 @@ class FleetCollector:
             results = list(self._pool().map(one, replicas))
         with self._lock:
             self.polls += 1
-            for name, snap, err in results:
+            for name, gen, snap, err in results:
                 r = self.replicas.get(name)
                 if r is None:
                     continue  # deregistered while the scrape was in flight
+                if r.registered_t != gen:
+                    continue  # re-registered: result is the OLD incarnation's
                 if err is not None:
                     self._on_scrape_fail(r, err, now)
                 else:

@@ -221,6 +221,28 @@ def build_scorecard(result, *, ttft_slo_ms: Optional[float] = None,
                 card["join"]["kv_restore_ms_p50"] = round(
                     restore_ms[len(restore_ms) // 2], 3
                 )
+        # offered-vs-capacity: grade the run's offered token rate
+        # against the capacity model's sustainable-rate estimate
+        # (telemetry/capacity.py) as sampled into the timeline — across
+        # hosts the key fleet-merges by SUM over live replicas, so this
+        # is the whole fleet's ceiling
+        try:
+            from .timeline import load_timeline
+
+            tl = load_timeline(telemetry_dir)
+            cap = tl.last("serving/capacity_tokens_per_s")
+        except (OSError, ValueError):
+            cap = None
+        if isinstance(cap, (int, float)) and cap > 0:
+            offered_rate = safe_rate(counts["tokens_out"], wall_s)
+            headroom = tl.last("serving/headroom_frac")
+            card["capacity"] = {
+                "capacity_tokens_per_s": round(float(cap), 3),
+                "offered_tokens_per_s": round(offered_rate, 3),
+                "utilization_frac": round(offered_rate / float(cap), 4),
+            }
+            if isinstance(headroom, (int, float)):
+                card["capacity"]["headroom_frac"] = round(float(headroom), 4)
     return card
 
 
@@ -291,6 +313,16 @@ def format_scorecard(card: dict) -> list:
             f"  joined {join.get('joined', 0)}/{counts.get('offered', 0)} "
             f"with server records ({join.get('prefix_hit_tokens', 0)} "
             "prefix-hit tokens)"
+        )
+    cap = card.get("capacity")
+    if cap:
+        lines.append(
+            f"  capacity: offered {cap.get('offered_tokens_per_s', 0.0)} / "
+            f"{cap.get('capacity_tokens_per_s', 0.0)} tok/s sustainable "
+            f"(utilization {cap.get('utilization_frac', 0.0):.3f}"
+            + (f", headroom {cap['headroom_frac']:.3f}"
+               if cap.get("headroom_frac") is not None else "")
+            + ")"
         )
     return lines
 

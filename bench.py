@@ -2034,6 +2034,113 @@ def _kv_tier_bench(cfg, *, page_size=16, num_slots=2, baseline=None):
     return out
 
 
+def _autoscale_bench(cfg, prompt_len, *, page_size=16, num_slots=2,
+                     n_requests=6, max_new=8):
+    """Closed-loop autoscaling rows (serving/autoscaler.py +
+    telemetry/capacity.py): one in-process replica behind the router,
+    then the real actuation path — the policy floor forces a scale-out,
+    the new replica passes the token-exact canary gate before
+    registration, and the collector must scrape it placeable.
+
+    - ``autoscale_reaction_s`` — decision to first verified token out of
+      the new replica (spawn is an in-process engine here, so this is
+      the canary-gate + registration floor, not subprocess warmup);
+    - ``fleet_capacity_tokens_per_s`` / ``fleet_headroom_frac`` — the
+      capacity model's sustainable-rate estimate summed over the live
+      fleet after the wave, against the offered rate it saw.
+    """
+    import dataclasses
+
+    from accelerate_tpu.models import DecoderLM
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving.autoscaler import Autoscaler, SpawnedReplica
+    from accelerate_tpu.serving.engine import ServingEngine
+    from accelerate_tpu.serving.replica_server import ReplicaServer
+    from accelerate_tpu.serving.router import Router, RouterConfig
+    from accelerate_tpu.telemetry.capacity import AutoscalePolicy, fleet_capacity
+
+    cap = -(-(prompt_len + max_new + page_size) // page_size) * page_size
+    cfg = dataclasses.replace(cfg, max_cache_len=min(cfg.max_seq_len, cap))
+    model_def = DecoderLM(cfg)
+    variables = model_def.init_variables(
+        jax.random.PRNGKey(0), batch_size=1, seq_len=prompt_len
+    )
+    params, _ = unbox_params(variables["params"])
+    chunk = max(page_size, prompt_len // 2)
+    servers = []
+
+    def mk(name):
+        engine = ServingEngine(
+            model_def, params, num_slots=num_slots,
+            max_cache_len=cfg.max_cache_len, prefill_chunks=(chunk,),
+            page_size=page_size, replica=name,
+        )
+        engine.telemetry = None
+        engine.warmup()
+        engine.mark_steady()
+        server = ReplicaServer(engine, name=name).start()
+        servers.append(server)
+        return server
+
+    def spawn_fn(name):
+        server = mk(name)
+        return SpawnedReplica(name, server.url, server=server)
+
+    first = mk("A")
+    router = Router(
+        {"A": first.url},
+        config=RouterConfig(poll_interval_s=0.1),
+    )
+    autoscaler = Autoscaler(
+        router,
+        policy=AutoscalePolicy(min_replicas=2, max_replicas=2,
+                               cooldown_s=0.0, confirm_evals=1),
+        spawn_fn=spawn_fn,
+        goldens=[{"prompt": list(range(3, 3 + prompt_len)),
+                  "seed": 1234, "max_new_tokens": max_new}],
+        canary_probes=2,
+    )
+    router.attach_autoscaler(autoscaler)
+    rng = np.random.RandomState(5)
+    try:
+        router.collector.poll_once()
+        # below the policy floor: the first evaluation must actuate the
+        # whole scale-out path (spawn -> canary gate -> register ->
+        # placeable within a poll)
+        record = autoscaler.evaluate_once()
+        assert record["action"] == "scale_out" and (
+            record["outcome"] == "scaled_out"
+        ), f"autoscale drill did not scale out: {record}"
+        # a wave across the now-2-replica fleet gives the capacity model
+        # decode walls + occupancy to estimate from
+        for i in range(n_requests):
+            res = router.submit(
+                [int(t) for t in rng.randint(0, cfg.vocab_size, (prompt_len,))],
+                max_new_tokens=max_new, seed=i,
+            )
+            assert res.done and res.outcome == "finished"
+        router.collector.poll_once()
+        gauges = router.collector.fleet_gauges()
+        capacity = fleet_capacity(gauges)
+        ledger = autoscaler.conservation()
+        assert ledger["conserved"], f"autoscale wave lost requests: {ledger}"
+        out = {
+            "autoscale_reaction_s": record.get("autoscale_reaction_s"),
+            "autoscale_stages": record.get("stages"),
+            "autoscale_replicas": autoscaler.fleet_size(),
+        }
+        if capacity is not None:
+            out["fleet_capacity_tokens_per_s"] = capacity[
+                "capacity_tokens_per_s"
+            ]
+            out["fleet_headroom_frac"] = capacity["headroom_frac"]
+        return out
+    finally:
+        router.close()
+        for s in servers:
+            s.close()
+
+
 def _pipeline_mem_worker():
     """Compiled temp-memory (stash + belts) for gpipe-under-AD vs the manual
     1F1B schedule at M=4S, on the 8-device CPU sim (the schedule's win is a
@@ -2415,6 +2522,15 @@ def main():
                     "kv_tier_hit_ratio_host", "kv_tier_hit_ratio_disk",
                     "kv_tier_hit_ratio_peer"):
             extra[key] = extra["kv_tiering"][key]
+        # closed-loop autoscaling rows: forced scale-out through the
+        # real actuation path (canary-gated registration) + the capacity
+        # model's fleet estimate — report --diff watches the reaction
+        extra["autoscale"] = _autoscale_bench(
+            ttft_cfg, 128, page_size=64, num_slots=2,
+        )
+        for key in ("autoscale_reaction_s", "fleet_capacity_tokens_per_s",
+                    "fleet_headroom_frac"):
+            extra[key] = extra["autoscale"].get(key)
         # the transfer_flush noise rows (median-of-rounds + spread; the
         # best-attempt phase breakdown above keeps the old shape)
         for v in ("bf16", "int8", "int4"):
@@ -2576,6 +2692,15 @@ def main():
                     "kv_tier_hit_ratio_host", "kv_tier_hit_ratio_disk",
                     "kv_tier_hit_ratio_peer"):
             extra[key] = extra["kv_tiering"][key]
+        # closed-loop autoscaling rows, CPU-sized (same actuation path
+        # as the TPU branch; the reaction floor diffs across rounds)
+        extra["autoscale"] = _autoscale_bench(
+            DecoderConfig.tiny(max_seq_len=256), 32, page_size=16,
+            num_slots=2, n_requests=6, max_new=8,
+        )
+        for key in ("autoscale_reaction_s", "fleet_capacity_tokens_per_s",
+                    "fleet_headroom_frac"):
+            extra[key] = extra["autoscale"].get(key)
 
     # static-audit regression rows (both branches; post-warmup pass)
     extra.update(_audit_rows())

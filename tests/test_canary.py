@@ -441,13 +441,21 @@ class TestTier1EdgeDrill:
             finally:
                 close_all(router, servers)
 
-        base = wave(False, "off")
-        instrumented = wave(True, "on")
-        ratio = base / instrumented  # instrumented throughput / baseline
+        # the waves are tens of milliseconds, so a single paired sample is
+        # at the mercy of the scheduler on a loaded host — a real overhead
+        # regression fails every attempt, noise doesn't
+        ratio = 0.0
+        for attempt in range(3):
+            base = wave(False, f"off{attempt}")
+            instrumented = wave(True, f"on{attempt}")
+            ratio = base / instrumented  # instrumented throughput / baseline
+            if ratio >= 0.7:
+                break
         assert ratio >= 0.7, (
             f"router instrumentation cost too much: {instrumented:.3f}s "
-            f"vs {base:.3f}s uninstrumented (ratio {ratio:.2f} < 0.7)"
+            f"vs {base:.3f}s uninstrumented (ratio {ratio:.2f} < 0.7 "
+            f"on every attempt)"
         )
         # and the instrumented wave actually produced its artifacts
-        assert (tmp_path / "on" / "router-requests.jsonl").exists()
-        assert (tmp_path / "on" / "router-decisions.jsonl").exists()
+        assert (tmp_path / f"on{attempt}" / "router-requests.jsonl").exists()
+        assert (tmp_path / f"on{attempt}" / "router-decisions.jsonl").exists()

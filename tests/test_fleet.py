@@ -25,6 +25,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import threading
 import time
 import urllib.request
 
@@ -201,6 +202,11 @@ class TestMergePolicy:
         assert merge_policy("canary/pass_ratio") == "mean"
         assert merge_policy("canary/last_pass_unix_s") == "max"
         assert merge_policy("canary/e2e_ttft_ms") == "max"
+        # the capacity-model pair (telemetry/capacity.py): fleet capacity
+        # is additive over LIVE replicas (a dead replica's tokens/s left
+        # with it), fleet headroom is a utilization, so it averages
+        assert merge_policy("serving/capacity_tokens_per_s") == "sum_live"
+        assert merge_policy("serving/headroom_frac") == "mean"
 
     def test_counters_conserve_across_dead_replica(self):
         a = {"serving/generated_tokens": 40, "serving/queue_depth": 2,
@@ -392,6 +398,78 @@ class TestHealthStateMachine:
         states = [e["state"] for e in c.alerts.events
                   if e["rule"] == "fleet/replica_down"]
         assert states == ["pending", "firing", "resolved"]
+
+    def test_reregistration_mid_poll_discards_the_stale_scrape(self):
+        """The autoscaler race: scale-in then scale-out reusing a slot
+        name while a scrape of the OLD process is still in flight. The
+        old scrape's failure must not become the NEW incarnation's first
+        transition — a fresh replica's first observed state can never be
+        unreachable/dead."""
+        fetch_blocked = threading.Event()
+        release = threading.Event()
+        ok = _snap({"serving_queue_depth": 0, "serving_load_score": 0.1})
+        b_scrapes = {"n": 0}
+
+        def fetch(target):
+            if target in ("a", "b2"):
+                return ok
+            # the old incarnation's endpoint: up once, then the scrape
+            # hangs and the connection dies (process reaped mid-scrape)
+            b_scrapes["n"] += 1
+            if b_scrapes["n"] == 1:
+                return ok
+            fetch_blocked.set()
+            assert release.wait(timeout=30.0)
+            raise OSError("connection reset by peer")
+
+        clock = {"t": 0.0}
+        c = FleetCollector(
+            [("A", "a"), ("B", "b")], fetch_fn=fetch,
+            clock=lambda: clock["t"], stale_after_s=5.0, dead_after_s=10.0,
+        )
+        clock["t"] = 1.0
+        c.poll_once(now=1.0)
+        assert c.replicas["B"].state == HEALTHY  # was genuinely up once
+
+        clock["t"] = 2.0
+        poller = threading.Thread(
+            target=c.poll_once, kwargs={"now": 2.0}, daemon=True
+        )
+        poller.start()
+        assert fetch_blocked.wait(timeout=30.0)
+        # the slot name is re-registered (new process, new target) while
+        # the old scrape is STILL in flight
+        clock["t"] = 2.5
+        c.add_replica("B", "b2")
+        assert c.replicas["B"].state == STARTING
+        assert c.replicas["B"].registered_t == 2.5
+        release.set()
+        poller.join(timeout=30.0)
+        assert not poller.is_alive()
+
+        # the stale failure was discarded: the newcomer is untouched
+        assert c.replicas["B"].state == STARTING
+        assert c.replicas["B"].last_err is None
+        assert c.replicas["B"].consecutive_failures == 0
+        assert not any(
+            e["to"] in (UNREACHABLE, DEAD)
+            for e in c.events if e["replica"] == "B"
+        )
+        # ...and its first real transition is starting -> healthy
+        clock["t"] = 3.0
+        c.poll_once(now=3.0)
+        assert c.replicas["B"].state == HEALTHY
+        walk = [(e["from"], e["to"]) for e in c.events
+                if e["replica"] == "B"]
+        assert walk == [
+            (STARTING, HEALTHY),            # first incarnation
+            (HEALTHY, STARTING),            # re-registered
+            (STARTING, HEALTHY),            # new incarnation's first walk
+        ]
+        re_reg = [e for e in c.events if e["replica"] == "B"
+                  and e["to"] == STARTING]
+        assert re_reg and "re-registered" in re_reg[0]["reason"]
+        c.close()
 
     def test_placement_reranks_monotonically_under_perturbation(self):
         """The acceptance contract: perturb queue depth, free pages, and
