@@ -19,7 +19,12 @@ serving host modules and flags:
   (an explicit ``"0"`` silently becomes the fallback: int-the-string
   first, THEN apply the default), ``os.environ.get(K) or 3`` (str when
   set, int when unset), and ``if os.environ.get(K, "0"):`` (``"0"`` is a
-  truthy string).
+  truthy string);
+- **unbounded artifact appends** — a direct append-mode ``open()`` of a
+  ``*.jsonl`` path outside ``telemetry/artifacts.py``: hand-rolled
+  appenders grow without rotation and tear records on crash — route the
+  write through ``ArtifactWriter`` (P2, baseline-able when the file is
+  genuinely bounded).
 
 Pure stdlib ``ast`` — this module is in the declared jax-free set and a
 tier-1 test asserts the full pass stays under 5 seconds, so it can gate
@@ -55,6 +60,47 @@ _CALLBACK_RE = re.compile(
 )
 
 _LOCKISH_ATTR_RE = re.compile(r"lock", re.IGNORECASE)
+
+# the one module allowed to open artifact files in append mode: it owns
+# rotation, generation bounds, and the unbuffered whole-record discipline
+_ARTIFACT_WRITER_PATH = "telemetry/artifacts.py"
+
+
+def _open_append_mode(node: ast.Call) -> Optional[str]:
+    """The mode string of an ``open(...)`` call when it appends, else
+    None. Checks the bare builtin and ``io.open``."""
+    fn = node.func
+    is_open = (isinstance(fn, ast.Name) and fn.id == "open") or (
+        isinstance(fn, ast.Attribute) and fn.attr == "open"
+        and isinstance(fn.value, ast.Name) and fn.value.id == "io"
+    )
+    if not is_open:
+        return None
+    mode = None
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            mode = kw.value.value
+    if mode is not None and "a" in mode:
+        return mode
+    return None
+
+
+def _jsonl_literal(node: ast.Call) -> Optional[str]:
+    """A ``.jsonl`` string constant anywhere in the call's first
+    (path) argument — covers bare literals, os.path.join parts, and
+    f-string segments."""
+    targets = node.args[:1] + [kw.value for kw in node.keywords
+                               if kw.arg in (None, "file")]
+    for root in targets:
+        for sub in ast.walk(root):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                    and ".jsonl" in sub.value:
+                return sub.value
+    return None
 
 
 def _env_get_call(node) -> Optional[ast.Call]:
@@ -222,6 +268,20 @@ class _ModuleLint(ast.NodeVisitor):
         return None
 
     def visit_Call(self, node):
+        if not self.relpath.endswith(_ARTIFACT_WRITER_PATH):
+            mode = _open_append_mode(node)
+            if mode is not None:
+                path_lit = _jsonl_literal(node)
+                if path_lit is not None:
+                    self._finding(
+                        "artifact-append", "P2", path_lit,
+                        f"append-mode open({path_lit!r}, {mode!r}) outside "
+                        "ArtifactWriter: the file grows without rotation and "
+                        "a crash mid-write tears the last record — use "
+                        "telemetry.artifacts.ArtifactWriter (or baseline "
+                        "this if the file is genuinely bounded)",
+                        node.lineno,
+                    )
         if isinstance(node.func, ast.Name) and node.func.id in ("int", "float"):
             for arg in node.args:
                 if isinstance(arg, ast.BoolOp):

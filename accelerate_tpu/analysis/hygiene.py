@@ -52,6 +52,8 @@ JAX_FREE_MODULES = (
     "accelerate_tpu.telemetry.waterfall",
     "accelerate_tpu.telemetry.scorecard",
     "accelerate_tpu.telemetry.capacity",
+    "accelerate_tpu.telemetry.artifacts",
+    "accelerate_tpu.telemetry.incidents",
     "accelerate_tpu.serving.pages",
     "accelerate_tpu.serving.tiers",
     "accelerate_tpu.serving.scheduler",
@@ -61,6 +63,7 @@ JAX_FREE_MODULES = (
     "accelerate_tpu.serving.loadgen",
     "accelerate_tpu.serving.autoscaler",
     "accelerate_tpu.commands.trace",
+    "accelerate_tpu.commands.incident",
     "accelerate_tpu.commands.report",
     "accelerate_tpu.commands.watch",
     "accelerate_tpu.commands.audit",

@@ -17,6 +17,7 @@ import sys
 _COMMANDS = (
     "config", "launch", "estimate", "merge", "test", "tpu_config",
     "trace", "report", "watch", "audit", "serve", "loadtest", "autoscale",
+    "incident",
 )
 
 

@@ -365,6 +365,35 @@ def load_autoscale_summary(target: str) -> dict:
     }
 
 
+def load_incident_summary(target: str) -> dict:
+    """Reconstructed incidents out of the alert logs: counts, still-open
+    tally, mean resolved duration, and a one-line digest per incident —
+    the teaser the full ``accelerate-tpu incident show`` expands."""
+    if not (_host_files(target, "alerts-host*.jsonl")
+            or _host_files(target, "alerts-fleet.jsonl")):
+        return {}
+    from ..telemetry.incidents import reconstruct_incidents, summarize_incidents
+
+    incidents = reconstruct_incidents(target)
+    if not incidents:
+        return {}
+    out = summarize_incidents(incidents)
+    out["recent"] = [
+        {
+            "index": i["index"], "rule": i["rule"], "state": i["state"],
+            "fired_t": i["fired_t"], "duration_s": i["duration_s"],
+            "exemplars": i["exemplars"][:3],
+            "top_stages": sorted(set(
+                r["top_stage"] for r in i.get("exemplar_requests") or []
+                if r.get("top_stage")
+            )),
+            "events": len(i.get("events") or []),
+        }
+        for i in incidents[-8:]
+    ]
+    return out
+
+
 def load_loadtest_scorecard(target: str) -> dict:
     """The SLO scorecard (``loadtest-scorecard.json`` written by
     ``accelerate-tpu loadtest --out DIR``): attainment per tenant and
@@ -393,6 +422,7 @@ def load_report(target: str) -> dict:
         "waterfall": load_waterfall_summary(target),
         "canary": load_canary_summary(target),
         "autoscale": load_autoscale_summary(target),
+        "incidents": load_incident_summary(target),
         "audit": load_audit(target),
         "loadtest": load_loadtest_scorecard(target),
     }
@@ -643,6 +673,26 @@ def format_report(data: dict) -> str:
                 + (f"  {stage_txt}" if stage_txt else "")
             )
 
+    inc = data.get("incidents") or {}
+    if inc.get("count"):
+        dur = (f', mean duration {inc["mean_duration_s"]:.1f}s'
+               if inc.get("mean_duration_s") is not None else "")
+        lines.append("")
+        lines.append(
+            f'incidents: {inc["count"]} reconstructed, {inc["open"]} open'
+            f'{dur} (`accelerate-tpu incident show <dir>` for the timeline)'
+        )
+        for row in inc.get("recent") or []:
+            ex = ",".join(str(r) for r in row.get("exemplars") or []) or "-"
+            top = "/".join(row.get("top_stages") or []) or "?"
+            d = (f'{row["duration_s"]:.1f}s'
+                 if row.get("duration_s") is not None else "open")
+            lines.append(
+                f'  #{row["index"]} {row["rule"]} [{row["state"]}] '
+                f'dur={d} events={row.get("events", 0)} '
+                f'exemplars={ex} dominant={top}'
+            )
+
     card = data.get("loadtest") or {}
     if card:
         from ..telemetry.scorecard import format_scorecard
@@ -819,6 +869,15 @@ def collect_diff_metrics(target: str) -> dict:
         for field in ("kv_restores", "kv_restore_ms_p50"):
             if isinstance(card.get(field), (int, float)):
                 out[f"loadtest/{field}"] = float(card[field])
+    # incident totals diff like any metric: a round with more incidents
+    # (or ones that stay open longer) regressed operationally even when
+    # every latency percentile held
+    inc = data.get("incidents") or {}
+    if inc:
+        out["incident/count"] = float(inc.get("count", 0))
+        out["incident/open"] = float(inc.get("open", 0))
+        if isinstance(inc.get("mean_duration_s"), (int, float)):
+            out["incident/mean_duration_s"] = float(inc["mean_duration_s"])
     out["recompiles_diagnosed"] = float(len(data.get("recompiles") or []))
     audit = data.get("audit") or {}
     if audit:
@@ -942,7 +1001,7 @@ def report_command(args) -> int:
             or data["recompiles"] or data["first_compiles"] or data["steps"]
             or data["timeline"] or data["usage"] or data["alerts"]
             or data["fleet"] or data["waterfall"] or data["canary"]
-            or data["audit"] or data["loadtest"]):
+            or data["incidents"] or data["audit"] or data["loadtest"]):
         print(f"no telemetry artifacts found under {args.target} — expected "
               "goodput-host*.json / costs-host*.json / forensics-host*.jsonl "
               "/ fleet.json / audit.json (see docs/telemetry.md)", file=sys.stderr)

@@ -172,11 +172,18 @@ def load_dir_frame(target: str, span_s: float = 600.0) -> dict:
         name: int(r.get("state") == "firing")
         for name, r in (alerts_data.get("rules") or {}).items()
     }
+    # the most recent firing edge's exemplar request ids per rule — the
+    # culprits `accelerate-tpu incident` / `trace --request-id` expand
+    exemplars: dict = {}
+    for evt in alerts_data.get("events") or []:
+        if evt.get("state") == "firing" and evt.get("exemplars"):
+            exemplars[evt["rule"]] = evt["exemplars"]
     usage = load_usage(target)
     return {
         "gauges": gauges,
         "history": history,
         "alerts": alerts,
+        "alert_exemplars": exemplars,
         "tenants": usage.get("tenants") or {},
         "samples": tl.sample_count,
         "last_t": now,
@@ -215,6 +222,15 @@ def render_frame(frame: dict, series_keys, width: int = 32) -> str:
         quiet = sorted(n for n, v in alerts.items() if not v)
         if firing:
             lines.append("  ALERTS FIRING: " + ", ".join(firing))
+            exemplars = frame.get("alert_exemplars") or {}
+            for name in firing:
+                ids = exemplars.get(name)
+                if ids:
+                    lines.append(
+                        f"    {name} culprits: "
+                        + ", ".join(str(r) for r in ids[:4])
+                        + "  (trace summary --request-id <id>)"
+                    )
         lines.append("  alerts ok: " + (", ".join(quiet) if quiet else "(none)"))
     else:
         lines.append("  alerts: (none configured / no events yet)")
@@ -318,6 +334,14 @@ def render_fleet_frame(collector, series_keys, width: int = 32,
     lines.append("")
     if firing:
         lines.append("  ALERTS FIRING: " + ", ".join(firing))
+        for name in firing:
+            ids = states[name].get("exemplars")
+            if ids:
+                lines.append(
+                    f"    {name} culprits: "
+                    + ", ".join(str(r) for r in ids[:4])
+                    + "  (trace summary --request-id <id>)"
+                )
     if states:
         quiet = sorted(n for n in states if n not in firing)
         lines.append("  alerts ok: " + (", ".join(quiet) if quiet else "(none)"))

@@ -292,9 +292,10 @@ class Autoscaler:
         self.last_reaction_s: Optional[float] = None
         self._fh = None
         if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            self._fh = open(
-                os.path.join(log_dir, "autoscale-decisions.jsonl"), "a"
+            from ..telemetry.artifacts import ArtifactWriter
+
+            self._fh = ArtifactWriter(
+                os.path.join(log_dir, "autoscale-decisions.jsonl")
             )
 
     # -- observe -------------------------------------------------------------
@@ -566,11 +567,7 @@ class Autoscaler:
                 del self.decisions[: len(self.decisions) - 512]
             fh = self._fh
         if fh is not None:
-            try:
-                fh.write(json.dumps(record) + "\n")
-                fh.flush()
-            except OSError:
-                pass
+            fh.write_line(json.dumps(record))
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -629,21 +626,8 @@ def load_autoscale_decisions(target: str) -> list:
     """Offline read of ``autoscale-decisions.jsonl`` under a telemetry
     dir — what ``report`` renders and the troubleshooting runbook reads
     against the timeline."""
-    path = (os.path.join(target, "autoscale-decisions.jsonl")
-            if os.path.isdir(target) else target)
-    out = []
-    try:
-        with open(path) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(rec, dict) and rec.get("action"):
-                    out.append(rec)
-    except OSError:
-        pass
-    return out
+    from ..telemetry.artifacts import artifact_files, iter_jsonl
+
+    paths = (artifact_files(target, "autoscale-decisions.jsonl")
+             if os.path.isdir(target) else artifact_files(target))
+    return [rec for rec in iter_jsonl(paths) if rec.get("action")]

@@ -317,12 +317,13 @@ class Router:
         self._decisions_fh = None
         self._requests_fh = None
         if self.config.log_dir and self.instrument:
-            os.makedirs(self.config.log_dir, exist_ok=True)
-            self._decisions_fh = open(
-                os.path.join(self.config.log_dir, "router-decisions.jsonl"), "a"
+            from ..telemetry.artifacts import ArtifactWriter
+
+            self._decisions_fh = ArtifactWriter(
+                os.path.join(self.config.log_dir, "router-decisions.jsonl")
             )
-            self._requests_fh = open(
-                os.path.join(self.config.log_dir, "router-requests.jsonl"), "a"
+            self._requests_fh = ArtifactWriter(
+                os.path.join(self.config.log_dir, "router-requests.jsonl")
             )
 
     @staticmethod
@@ -383,10 +384,18 @@ class Router:
 
     # -- golden signals ------------------------------------------------------
 
-    def _observe(self, key: str, seconds: float):
+    def _observe(self, key: str, seconds: float, exemplar=None):
         h = self.hists.get(key)
         if h is not None:
-            h.add(seconds)
+            h.observe(seconds, exemplar=exemplar)
+
+    @staticmethod
+    def _exemplar(req: RouterRequest, replica=None) -> dict:
+        ex = {"request_id": req.id}
+        replica = replica or getattr(req, "replica", None)
+        if replica:
+            ex["replica"] = str(replica)
+        return ex
 
     def _note_decision(self, req: RouterRequest, hop_index: int,
                        chosen: str, rows: list, excluded, reason: str,
@@ -417,11 +426,7 @@ class Router:
                 del self.decisions[: len(self.decisions) - cap]
             fh = self._decisions_fh
             if fh is not None:
-                try:
-                    fh.write(json.dumps(entry) + "\n")
-                    fh.flush()
-                except OSError:
-                    pass
+                fh.write_line(json.dumps(entry))
 
     def _finalize(self, req: RouterRequest):
         """Terminal bookkeeping for every outcome path: the e2e
@@ -430,7 +435,8 @@ class Router:
         if not self.instrument:
             return
         if req.finish_t is not None:
-            self._observe("router/e2e", max(0.0, req.finish_t - req.submit_t))
+            self._observe("router/e2e", max(0.0, req.finish_t - req.submit_t),
+                          exemplar=self._exemplar(req))
         fh = self._requests_fh
         if fh is None:
             return
@@ -457,11 +463,7 @@ class Router:
         }
         with self._log_lock:
             if self._requests_fh is not None:
-                try:
-                    self._requests_fh.write(json.dumps(rec) + "\n")
-                    self._requests_fh.flush()
-                except OSError:
-                    pass
+                self._requests_fh.write_line(json.dumps(rec))
 
     # -- placement ----------------------------------------------------------
 
@@ -683,10 +685,12 @@ class Router:
             if not queued:
                 queued = True
                 self._observe("router/queue_wait",
-                              max(0.0, place_start - req.submit_t))
+                              max(0.0, place_start - req.submit_t),
+                              exemplar=self._exemplar(req))
             names, rows, sticky = self._ranked(req.session, exclude=excluded)
             place_end = self._clock()
-            self._observe("router/placement", max(0.0, place_end - place_start))
+            self._observe("router/placement", max(0.0, place_end - place_start),
+                          exemplar=self._exemplar(req))
             if not names:
                 with self._lock:
                     any_known = bool(self._replicas)
@@ -841,9 +845,11 @@ class Router:
             req.first_token_t = now
             if self.instrument:
                 hop["first_token_unix_s"] = round(now, 6)
-                self._observe("router/ttft", max(0.0, now - req.submit_t))
+                self._observe("router/ttft", max(0.0, now - req.submit_t),
+                              exemplar=self._exemplar(req, hop.get("replica")))
         elif req.last_token_t is not None:
-            self._observe("router/itl", max(0.0, now - req.last_token_t))
+            self._observe("router/itl", max(0.0, now - req.last_token_t),
+                          exemplar=self._exemplar(req, hop.get("replica")))
         req.last_token_t = now
         if on_token is not None:
             on_token(token, req)

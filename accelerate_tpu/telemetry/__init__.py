@@ -123,6 +123,15 @@ class TelemetryConfig:
     token_span_every: int = 0              # per-token decode spans for 1-in-N requests (0 = off)
     itl_series_max: int = 512              # ITL samples kept per request record
     exporter_port: Optional[int] = None    # Prometheus scrape thread (0 = ephemeral port)
+    # exemplar reservoirs on the SLO histograms: sampled request ids ride
+    # the exposition and name culprits at alert firing edges (off = the
+    # histograms observe values only — the zero-overhead witness baseline)
+    exemplars: bool = True
+    # JSONL artifact retention (telemetry/artifacts.py): every family's
+    # writer rotates at artifact_max_bytes keeping artifact_generations
+    # rotated files per family
+    artifact_max_bytes: int = 64 * 1024 * 1024
+    artifact_generations: int = 3
     # explanatory layer (docs/telemetry.md: goodput + roofline; the
     # forensics JSONL needs trace_dir, the in-memory diffing does not)
     forensics: bool = True             # recompile cause diffing + JSONL
@@ -264,10 +273,7 @@ class TelemetrySession:
                 self.trace_dir, f"metrics-host{self.process_index}.jsonl"
             )
         if path:
-            d = os.path.dirname(path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            self._metrics_fh = open(path, "a")
+            self._metrics_fh = self.artifact_writer(path)
 
         from ..utils.compile_cache import compile_event_counters, install_compile_listeners
 
@@ -383,6 +389,7 @@ class TelemetrySession:
                     )
                 self.alerts = _alerts.AlertManager(
                     self.timeline, rules, session=self, log_path=apath,
+                    exemplar_source=self._alert_exemplars,
                 )
             if config.timeline_interval_s and config.timeline_interval_s > 0:
                 self._sampler = TimelineSampler(
@@ -476,7 +483,28 @@ class TelemetrySession:
         h = self.hists.get(name)
         if h is None:
             h = self.hists[name] = StreamingHistogram()
+            h.exemplars_enabled = bool(self.config.exemplars)
         return h
+
+    def artifact_writer(self, path: str):
+        """A bounded-rotation JSONL appender for ``path`` honoring the
+        session's retention config — the one append path every artifact
+        family (metrics, requests, alerts, decisions) shares."""
+        from .artifacts import ArtifactWriter
+
+        return ArtifactWriter(
+            path,
+            max_bytes=self.config.artifact_max_bytes,
+            max_generations=self.config.artifact_generations,
+        )
+
+    def _alert_exemplars(self, key: str) -> list:
+        """Exemplar request descriptors for the histogram backing an
+        alert-rule key — stamped onto firing-edge alert events so the
+        event log names culprit requests, not just a breached number."""
+        from .alerts import exemplars_for_key
+
+        return exemplars_for_key(self.hists, key)
 
     def _on_stall(self, report: str):
         """Watchdog trip: dump a flight-recorder bundle and (when a
@@ -709,8 +737,7 @@ class TelemetrySession:
         gn = self._resolve(rec.get("_grad_norm"))
         if gn is not None:
             out["grad_norm"] = gn
-        self._metrics_fh.write(json.dumps(out) + "\n")
-        self._metrics_fh.flush()
+        self._metrics_fh.write_line(json.dumps(out))
 
     def peak_flops(self) -> float:
         if self._peak is None:

@@ -323,6 +323,7 @@ class _RuleState:
     value: Optional[float] = None     # last evaluated value
     fired_count: int = 0
     last_fired: Optional[float] = None
+    exemplars: Optional[list] = None  # culprit ids at the last firing edge
 
 
 class AlertManager:
@@ -331,7 +332,7 @@ class AlertManager:
 
     def __init__(self, timeline, rules, *, session=None,
                  log_path: Optional[str] = None, clock=time.time,
-                 max_events: int = 512):
+                 max_events: int = 512, exemplar_source=None):
         names = [r.name for r in rules]
         if len(names) != len(set(names)):
             raise ValueError(f"duplicate alert rule names in {names}")
@@ -341,6 +342,11 @@ class AlertManager:
         self.log_path = log_path
         self._clock = clock
         self._fh = None
+        # ``exemplar_source(rule_key) -> [request_id, ...]`` names the
+        # culprit requests behind the breached series at firing edge
+        # (the session wires its own histograms in; the fleet collector
+        # its merged ones). Read-only dict walks — safe under the lock.
+        self.exemplar_source = exemplar_source
         # reentrant: an action (flight dump) may re-enter rollup_keys()
         # on the same thread via session.host_rollup()
         self._lock = threading.RLock()
@@ -395,7 +401,7 @@ class AlertManager:
         return emitted
 
     def _event(self, rule, st: _RuleState, state: str, now: float) -> dict:
-        return {
+        evt = {
             "t_unix_s": round(now, 3),
             "rule": rule.name,
             "state": state,
@@ -403,6 +409,19 @@ class AlertManager:
             "severity": getattr(rule, "severity", "page"),
             "description": getattr(rule, "description", ""),
         }
+        if state == FIRING and self.exemplar_source is not None:
+            key = getattr(rule, "key", None)
+            try:
+                ids = list(self.exemplar_source(key) or []) if key else []
+            except Exception:
+                ids = []  # a sick exemplar source must not break the edge
+            if ids:
+                # the firing-edge event names culprit requests — the
+                # entry point for `trace summary --request-id` and the
+                # incident correlator's waterfall stitching
+                evt["exemplars"] = ids[:8]
+                st.exemplars = ids[:8]
+        return evt
 
     def _run_actions(self, rule, st: _RuleState):
         session = self.session
@@ -432,12 +451,10 @@ class AlertManager:
             return
         try:
             if self._fh is None:
-                d = os.path.dirname(self.log_path)
-                if d:
-                    os.makedirs(d, exist_ok=True)
-                self._fh = open(self.log_path, "a")
-            self._fh.write(json.dumps(evt) + "\n")
-            self._fh.flush()
+                from .artifacts import ArtifactWriter
+
+                self._fh = ArtifactWriter(self.log_path)
+            self._fh.write(evt)
         except OSError:
             pass
 
@@ -453,15 +470,20 @@ class AlertManager:
         """{rule: {state, value, fired_count, since}} — what the exporter
         and ``watch`` render."""
         with self._lock:
-            return {
-                name: {
+            out = {}
+            for name, st in self.states.items():
+                row = {
                     "state": st.state,
                     "value": st.value,
                     "fired_count": st.fired_count,
                     "since": st.since,
                 }
-                for name, st in self.states.items()
-            }
+                if st.exemplars and st.state == FIRING:
+                    # watch renders the culprit request ids next to the
+                    # firing rule — the four-command path starts here
+                    row["exemplars"] = list(st.exemplars)
+                out[name] = row
+            return out
 
     def rollup_keys(self) -> dict:
         """Flat ``alerts/*`` gauges for the session rollup (and through
@@ -483,21 +505,57 @@ class AlertManager:
             self._fh = None
 
 
+def exemplars_for_key(hists: dict, key: Optional[str], k: int = 4) -> list:
+    """Culprit request ids behind a rule key: strip the percentile
+    suffix (``serving/itl_recent_p99_ms`` -> ``serving/itl``), find the
+    matching histogram, and return its worst exemplars value-descending
+    (deduped by request id). Empty when the key names no histogram —
+    fleet/canary counter rules have no per-request story to tell."""
+    if not key or not hists:
+        return []
+    base = key
+    for suffix in ("_recent_p99_ms", "_recent_p95_ms", "_recent_p50_ms",
+                   "_p99_ms", "_p95_ms", "_p50_ms", "_mean_ms", "_max_ms",
+                   "_count"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+            break
+    hist = hists.get(base)
+    if hist is None:
+        return []
+    from .histograms import _entry_dict
+
+    entries = [_entry_dict(e)
+               for res in dict(getattr(hist, "exemplars", {})).values()
+               for e in res]
+    entries.sort(key=lambda e: (e.get("value") or 0.0,
+                                e.get("unix_s") or 0.0), reverse=True)
+    out: list = []
+    for e in entries:
+        rid = e.get("request_id")
+        if rid is not None and rid not in out:
+            out.append(rid)
+        if len(out) >= k:
+            break
+    return out
+
+
 def load_alerts(target: str) -> dict:
-    """Offline read of ``alerts-host*.jsonl`` under a telemetry dir:
-    event list (time-ordered, host-tagged) plus per-rule summary with
-    each rule's final state — the ``report``/``watch`` data source."""
-    import glob
+    """Offline read of ``alerts-host*.jsonl`` under a telemetry dir
+    (every rotated generation included): event list (time-ordered,
+    host-tagged) plus per-rule summary with each rule's final state —
+    the ``report``/``watch`` data source."""
+    from .artifacts import artifact_files
 
     if os.path.isdir(target):
-        paths = sorted(
-            glob.glob(os.path.join(target, "alerts-host*.jsonl"))
+        paths = (
+            artifact_files(target, "alerts-host*.jsonl")
             # the fleet collector's rule evaluations (telemetry/fleet.py)
             # land beside the per-host logs and merge the same way
-            + glob.glob(os.path.join(target, "alerts-fleet.jsonl"))
+            + artifact_files(target, "alerts-fleet.jsonl")
         )
     elif os.path.exists(target):
-        paths = [target]
+        paths = artifact_files(target)
     else:
         paths = []
     events = []

@@ -181,8 +181,11 @@ class CanaryProber:
         self._recent: list = []       # bounded pass/fail ring (pass_ratio)
         self._fh = None
         if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            self._fh = open(os.path.join(log_dir, "canary-results.jsonl"), "a")
+            from .artifacts import ArtifactWriter
+
+            self._fh = ArtifactWriter(
+                os.path.join(log_dir, "canary-results.jsonl")
+            )
 
     # -- probing -------------------------------------------------------------
 
@@ -251,11 +254,7 @@ class CanaryProber:
                 del self.results[: len(self.results) - self.history]
             fh = self._fh
         if fh is not None:
-            try:
-                fh.write(json.dumps(result) + "\n")
-                fh.flush()
-            except OSError:
-                pass
+            fh.write_line(json.dumps(result))
         if not passed:
             # remediation must not break probing: both hooks best-effort
             if self.on_fail is not None:
@@ -333,21 +332,8 @@ def load_canary(target: str) -> list:
     """Offline read of ``canary-results.jsonl`` under a telemetry dir —
     the ``report``/triage data source (which replica served each failing
     probe, and when)."""
-    path = (os.path.join(target, "canary-results.jsonl")
-            if os.path.isdir(target) else target)
-    out = []
-    try:
-        with open(path) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(rec, dict) and "passed" in rec:
-                    out.append(rec)
-    except OSError:
-        pass
-    return out
+    from .artifacts import artifact_files, iter_jsonl
+
+    paths = (artifact_files(target, "canary-results.jsonl")
+             if os.path.isdir(target) else artifact_files(target))
+    return [rec for rec in iter_jsonl(paths) if "passed" in rec]

@@ -112,6 +112,7 @@ def prometheus_text(session) -> str:
         if isinstance(v, (dict, list, tuple, str)):
             continue
         name = _metric_name(key)
+        lines.append(f"# HELP {name} {key}")
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {_fmt(v)}")
     # freshness marker: seconds since the session last folded a timeline
@@ -149,9 +150,26 @@ def prometheus_text(session) -> str:
             # from the snapshot so the +Inf bucket stays consistent
             count = buckets[-1][1]
             base = _metric_name(hname) + "_seconds"
+            lines.append(f"# HELP {base} {hname} latency histogram")
             lines.append(f"# TYPE {base} histogram")
+            exemplars = {}
+            try:
+                exemplars = hist.exposition_exemplars()
+            except Exception:
+                pass
             for le, cum in buckets:
-                lines.append(f'{base}_bucket{{le="{le:.9g}"}} {cum}')
+                line = f'{base}_bucket{{le="{le:.9g}"}} {cum}'
+                ex = exemplars.get(le)
+                if ex is not None:
+                    # OpenMetrics exemplar syntax: the bucket line carries
+                    # a sampled request id + its exact value/timestamp —
+                    # the p99's path back to a concrete request
+                    labels = f'request_id="{escape_label_value(ex["request_id"])}"'
+                    if ex.get("replica"):
+                        labels += f',replica="{escape_label_value(ex["replica"])}"'
+                    line += (f' # {{{labels}}} {ex["value"]:.9g}'
+                             f' {ex.get("unix_s") or 0:.3f}')
+                lines.append(line)
             lines.append(f'{base}_bucket{{le="+Inf"}} {count}')
             lines.append(f"{base}_sum {_fmt(hist.sum)}")
             lines.append(f"{base}_count {count}")

@@ -101,6 +101,40 @@ _LINE_RE = re.compile(
 )
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 _ESC_RE = re.compile(r"\\(.)")
+# OpenMetrics exemplar suffix on a _bucket line: `# {labels} value [ts]`
+_EXEMPLAR_RE = re.compile(
+    r"^\{(?P<labels>.*)\}\s+(?P<value>[^\s]+)(?:\s+(?P<ts>[0-9.eE+-]+))?$"
+)
+
+
+def _parse_exemplar(suffix: str):
+    """``{request_id="..",replica=".."} 0.087 1700000000.123`` -> entry
+    dict, or None on any malformation (an exemplar is a debug hint; a
+    torn or hostile suffix must cost nothing but itself)."""
+    m = _EXEMPLAR_RE.match(suffix.strip())
+    if m is None:
+        return None
+    labels = {k: _unescape(raw)
+              for k, raw in _LABEL_RE.findall(m.group("labels"))}
+    rid = labels.get("request_id")
+    if rid is None:
+        return None
+    try:
+        value = float(m.group("value"))
+    except ValueError:
+        return None
+    if value != value:
+        return None
+    entry = {"request_id": rid, "value": value}
+    if labels.get("replica"):
+        entry["replica"] = labels["replica"]
+    ts = m.group("ts")
+    if ts is not None:
+        try:
+            entry["unix_s"] = float(ts)
+        except ValueError:
+            pass
+    return entry
 
 
 def _unescape(value: str) -> str:
@@ -139,6 +173,15 @@ def parse_exposition(text: str) -> ExpositionSnapshot:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        # an OpenMetrics exemplar rides after ` # ` on bucket lines; it
+        # must come off BEFORE the series match (the greedy label group
+        # would otherwise swallow the exemplar's own label block and
+        # misparse the exemplar value as the bucket count)
+        exemplar = None
+        if " # " in line:
+            line, _, suffix = line.partition(" # ")
+            line = line.rstrip()
+            exemplar = _parse_exemplar(suffix)
         m = _LINE_RE.match(line)
         if m is None:
             snap.skipped_lines += 1
@@ -171,10 +214,12 @@ def parse_exposition(text: str) -> ExpositionSnapshot:
             except ValueError:
                 continue
             hist = snap.histograms.setdefault(
-                base, {"buckets": [], "sum": 0.0, "count": 0}
+                base, {"buckets": [], "sum": 0.0, "count": 0, "exemplars": []}
             )
             if le != float("inf") and v == v:
                 hist["buckets"].append((le, int(v)))
+                if exemplar is not None:
+                    hist["exemplars"].append((le, exemplar))
             continue
         if labels:
             # other labeled families (future exporters): not flat gauges
@@ -419,6 +464,7 @@ def merge_histograms(snapshots: list, *, lo: float = 1e-6,
                 h = StreamingHistogram.from_cumulative(
                     data.get("buckets") or [], sum_value=data.get("sum", 0.0),
                     lo=lo, growth=growth,
+                    exemplars=data.get("exemplars"),
                 )
             except ValueError:
                 continue
@@ -565,7 +611,11 @@ class FleetCollector:
         alert_log = None
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
-            self._events_fh = open(os.path.join(log_dir, "fleet-events.jsonl"), "a")
+            from .artifacts import ArtifactWriter
+
+            self._events_fh = ArtifactWriter(
+                os.path.join(log_dir, "fleet-events.jsonl")
+            )
             alert_log = os.path.join(log_dir, "alerts-fleet.jsonl")
         from .alerts import AlertManager
 
@@ -574,9 +624,11 @@ class FleetCollector:
                 replica_down_for_s=replica_down_for_s, itl_slo_ms=itl_slo_ms
             )
         self.alerts = AlertManager(
-            self.timeline, rules, log_path=alert_log, clock=clock
+            self.timeline, rules, log_path=alert_log, clock=clock,
+            exemplar_source=self._alert_exemplars,
         )
         self._last_merged: dict = {}
+        self._last_hists: dict = {}  # unflattened name -> merged histogram
         self._executor = None  # lazy scrape pool (poll_once builds it)
         self._dir_cache: dict = {}  # target -> (file sig, gauges, last_t)
         self._dir_cache_lock = threading.Lock()
@@ -717,8 +769,7 @@ class FleetCollector:
             del self.events[: len(self.events) - self._max_events]
         if self._events_fh is not None:
             try:
-                self._events_fh.write(json.dumps(evt) + "\n")
-                self._events_fh.flush()
+                self._events_fh.write(evt)
             except OSError:
                 pass
 
@@ -833,8 +884,14 @@ class FleetCollector:
         hists = merge_histograms([
             r.histograms for r in self.replicas.values() if r.histograms
         ])
+        by_name = {}
         for base, hist in hists.items():
-            merged.update(percentile_keys(unflatten_key(base), hist))
+            name = unflatten_key(base)
+            by_name[name] = hist
+            merged.update(percentile_keys(name, hist))
+        # the merged histograms (with their unioned exemplars) are what
+        # names culprit requests at a fleet alert's firing edge
+        self._last_hists = by_name
         counts: dict = {s: 0 for s in HEALTH_STATES}
         for r in self.replicas.values():
             counts[r.state] += 1
@@ -849,6 +906,17 @@ class FleetCollector:
         merged["fleet/scrapes_failed"] = self.scrapes_failed
         merged["fleet/poll_t_unix_s"] = round(now, 3)
         return merged
+
+    def _alert_exemplars(self, key: str) -> list:
+        """Culprit request ids for an alert keyed on ``key`` (e.g.
+        ``serving/itl_recent_p99_ms`` -> the merged ``serving/itl``
+        histogram's worst exemplars) — the firing-edge link from a fleet
+        alert to concrete requests."""
+        from .alerts import exemplars_for_key
+
+        with self._lock:
+            hists = dict(self._last_hists)
+        return exemplars_for_key(hists, key)
 
     def start(self) -> "FleetCollector":
         if self._sampler is None:
@@ -1011,21 +1079,10 @@ def load_fleet(target: str) -> dict:
     except (OSError, ValueError):
         out = {}
     d = target if os.path.isdir(target) else os.path.dirname(target)
-    events = []
-    try:
-        with open(os.path.join(d, "fleet-events.jsonl")) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    evt = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(evt, dict) and evt.get("replica"):
-                    events.append(evt)
-    except OSError:
-        pass
+    from .artifacts import read_jsonl
+
+    events = [evt for evt in read_jsonl(d, "fleet-events.jsonl")
+              if evt.get("replica")]
     if events:
         events.sort(key=lambda e: e.get("t_unix_s", 0))
         out["events"] = events

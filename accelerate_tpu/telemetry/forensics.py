@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import enum
 import json
-import os
 import threading
 import time
 from typing import Optional
@@ -174,10 +173,9 @@ class ForensicsRecorder:
         self._lock = threading.Lock()
         self._fh = None
         if path:
-            d = os.path.dirname(path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            self._fh = open(path, "a")
+            from .artifacts import ArtifactWriter
+
+            self._fh = ArtifactWriter(path)
 
     @staticmethod
     def _counters() -> dict:
@@ -252,8 +250,7 @@ class ForensicsRecorder:
         rec["compile_cache_hits"] = now["cache_hits"] - mark["cache_hits"]
         self.records.append(rec)
         if self._fh is not None and not self._fh.closed:
-            self._fh.write(json.dumps(rec) + "\n")
-            self._fh.flush()
+            self._fh.write_line(json.dumps(rec))
         span = self.span_recorder() if callable(self.span_recorder) else self.span_recorder
         if span is not None:
             try:

@@ -20,7 +20,64 @@ The bucket layout doubles as the Prometheus histogram exposition
 from __future__ import annotations
 
 import math
+import time
 from typing import Optional
+
+# per-bucket exemplar reservoir: the latest observation plus the largest
+# one — two slots is enough to answer both "what just landed here" and
+# "what was the worst", and bounds memory at 2 * buckets-touched
+EXEMPLARS_PER_BUCKET = 2
+
+
+def _reservoir_put(cur: Optional[list], entry: dict) -> list:
+    """Fold one exemplar into a bucket reservoir: keep the max-valued
+    entry and the newest entry (``entry`` is by definition the newest —
+    newest-wins, the same policy the fleet merge applies)."""
+    if not cur:
+        return [entry]
+    best = max(cur, key=lambda e: e.get("value") or 0.0)
+    if (entry.get("value") or 0.0) >= (best.get("value") or 0.0):
+        return [entry]
+    return [best, entry]
+
+
+def _entry_value(e) -> float:
+    return e[0] if type(e) is tuple else (e.get("value") or 0.0)
+
+
+def _entry_time(e) -> float:
+    return e[1] if type(e) is tuple else (e.get("unix_s") or 0.0)
+
+
+def _entry_dict(e) -> dict:
+    """Normalize one reservoir entry to the exposition dict shape.
+    ``observe`` stores compact ``(value, unix_s, descriptor)`` tuples —
+    it is the per-token hot path and must not build a dict per
+    observation — and every reader normalizes through here."""
+    if type(e) is not tuple:
+        return e
+    v, t, ex = e
+    out = {"request_id": str(ex.get("request_id")), "value": v,
+           "unix_s": round(t, 3)}
+    replica = ex.get("replica")
+    if replica:
+        out["replica"] = str(replica)
+    return out
+
+
+def _reservoir_union(a: Optional[list], b: Optional[list]) -> list:
+    """Bounded union of two bucket reservoirs: the max-valued entry plus
+    the newest entry across both sides (newest-wins on ties). Accepts
+    mixed tuple/dict entries; always returns normalized dicts."""
+    merged = [_entry_dict(e) for e in list(a or []) + list(b or [])]
+    if not merged:
+        return []
+    best = max(merged, key=lambda e: (e.get("value") or 0.0,
+                                      e.get("unix_s") or 0.0))
+    newest = max(merged, key=lambda e: e.get("unix_s") or 0.0)
+    if newest is best:
+        return [best]
+    return [best, newest]
 
 
 class StreamingHistogram:
@@ -41,17 +98,65 @@ class StreamingHistogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        # bucket index -> bounded exemplar reservoir ([{request_id,
+        # value, unix_s, replica?}, ...], at most EXEMPLARS_PER_BUCKET)
+        self.exemplars: dict = {}
+        self.exemplars_enabled = True
+
+    def _bucket_index(self, v: float) -> int:
+        return 0 if v <= self.lo else 1 + int(
+            math.log(v / self.lo) / self._log_growth
+        )
 
     def add(self, value: float):
         v = float(value)
         if v != v or v < 0:  # NaN / negative clock skew: drop, don't poison
             return
-        idx = 0 if v <= self.lo else 1 + int(math.log(v / self.lo) / self._log_growth)
+        idx = self._bucket_index(v)
         self.counts[idx] = self.counts.get(idx, 0) + 1
         self.count += 1
         self.sum += v
         self.min = v if self.min is None else min(self.min, v)
         self.max = v if self.max is None else max(self.max, v)
+
+    def observe(self, value: float, exemplar: Optional[dict] = None):
+        """``add`` plus an optional exemplar — the trace-linkage hook the
+        serving observation sites call with the live request id:
+        ``hist.observe(ttft_s, exemplar={"request_id": req.id,
+        "replica": "r0"})``. The exemplar joins the bounded per-bucket
+        reservoir (latest + max); a missing/disabled exemplar makes this
+        exactly ``add``."""
+        v = float(value)
+        if v != v or v < 0:
+            return
+        idx = self._bucket_index(v)
+        self.counts[idx] = self.counts.get(idx, 0) + 1
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        if not exemplar or not self.exemplars_enabled:
+            return
+        if exemplar.get("request_id") is None:
+            return
+        # compact-tuple write path (normalized to dicts only at read, by
+        # ``_entry_dict``), with ``_reservoir_put`` inlined against the
+        # invariant every reservoir writer maintains: res[0] is the
+        # max-valued entry, res[-1] the newest. This is the per-token hot
+        # path — a dict build + key-lambda max() per observation is what
+        # the bench's zero-overhead witness caught. The descriptor is
+        # stored BY REFERENCE: callers pass one stable dict per request
+        # (the tracer caches it on the record), never a mutated shared one.
+        entry = (v, exemplar.get("unix_s") or time.time(), exemplar)
+        res = self.exemplars.get(idx)
+        if res is None:
+            self.exemplars[idx] = [entry]
+        elif v >= _entry_value(res[0]):
+            res[:] = [entry]
+        elif len(res) == 1:
+            res.append(entry)
+        else:
+            res[-1] = entry
 
     def upper_edge(self, idx: int) -> float:
         """Inclusive upper bound of bucket ``idx``."""
@@ -113,11 +218,16 @@ class StreamingHistogram:
             self.min = other.min if self.min is None else min(self.min, other.min)
         if other.max is not None:
             self.max = other.max if self.max is None else max(self.max, other.max)
+        # exemplars union bounded per bucket, newest-wins: a fleet merge
+        # of N replicas still holds at most EXEMPLARS_PER_BUCKET each
+        for idx, res in other.exemplars.items():
+            self.exemplars[idx] = _reservoir_union(self.exemplars.get(idx), res)
 
     @classmethod
     def from_cumulative(cls, buckets, *, sum_value: float = 0.0,
                         lo: float = 1e-6, growth: float = 1.25,
-                        tolerance: float = 0.01) -> "StreamingHistogram":
+                        tolerance: float = 0.01,
+                        exemplars=None) -> "StreamingHistogram":
         """Rebuild a histogram from exposition-format cumulative buckets
         (``[(le_seconds, cumulative_count), ...]`` — the inverse of
         :meth:`cumulative_buckets`, which is how the fleet collector
@@ -152,7 +262,62 @@ class StreamingHistogram:
             h.counts[idx] = h.counts.get(idx, 0) + n
         h.count = prev
         h.sum = float(sum_value)
+        # exposition-carried exemplars ride back in, keyed by their
+        # bucket edge (``[(le_seconds, entry), ...]`` — what
+        # ``parse_exposition`` collects); an off-grid or malformed entry
+        # is dropped, never raised — exemplars are debug hints, not data
+        for le, entry in (exemplars or []):
+            if not isinstance(entry, dict) or entry.get("request_id") is None:
+                continue
+            try:
+                v = float(entry.get("value") or le)
+                idx = h._bucket_index(v)
+            except (TypeError, ValueError):
+                continue
+            e = {"request_id": str(entry["request_id"]), "value": v,
+                 "unix_s": round(float(entry.get("unix_s") or 0.0), 3)}
+            if entry.get("replica"):
+                e["replica"] = str(entry["replica"])
+            h.exemplars[idx] = _reservoir_put(h.exemplars.get(idx), e)
         return h
+
+    def exposition_exemplars(self) -> dict:
+        """``{le_seconds: entry}`` — the one exemplar per bucket the
+        Prometheus exposition renders (OpenMetrics allows a single
+        exemplar per ``_bucket`` line; the newest wins, matching the
+        fleet-merge policy)."""
+        out = {}
+        for idx, res in sorted(dict(self.exemplars).items()):
+            if not res:
+                continue
+            out[self.upper_edge(idx)] = _entry_dict(max(res, key=_entry_time))
+        return out
+
+    def exemplar_near_quantile(self, q: float) -> Optional[dict]:
+        """The exemplar closest to the q-quantile bucket — preferring the
+        quantile bucket itself, then the nearest bucket below (a tail
+        quantile's culprit), then the nearest above. This is what names a
+        concrete request id next to a p99."""
+        counts = dict(self.counts)
+        exemplars = dict(self.exemplars)
+        if not counts or not exemplars:
+            return None
+        total = sum(counts.values())
+        target, seen = q * total, 0
+        q_idx = max(counts)
+        for idx in sorted(counts):
+            seen += counts[idx]
+            if seen >= target:
+                q_idx = idx
+                break
+        have = sorted(exemplars)
+        below = [i for i in have if i <= q_idx]
+        pick = below[-1] if below else have[0]
+        res = exemplars.get(pick) or []
+        if not res:
+            return None
+        return _entry_dict(max(res, key=lambda e: (_entry_value(e),
+                                                   _entry_time(e))))
 
     def snapshot(self) -> dict:
         """{count, sum_s, min_s, max_s, mean_s, p50_s, p95_s, p99_s} or {}."""
@@ -185,4 +350,9 @@ def percentile_keys(name: str, hist: StreamingHistogram) -> dict:
         # has no observed min/max — skip those keys, don't crash rollups
         if v is not None:
             out[f"{name}_{key}"] = round(v * 1e3, 3)
+    e = hist.exemplar_near_quantile(0.99)
+    if e is not None:
+        # a string value: the exporter's gauge loop skips it (an id is
+        # not a series), but watch/report/alerts read it off the rollup
+        out[f"{name}_p99_exemplar"] = str(e["request_id"])
     return out

@@ -31,7 +31,6 @@ marginal cost is one method call and a few dict writes per event.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from typing import Optional
@@ -56,10 +55,12 @@ class RequestTracer:
         self.path = path
         self.records_written = 0
         if path:
-            d = os.path.dirname(path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            self._fh = open(path, "a")
+            if session is not None and hasattr(session, "artifact_writer"):
+                self._fh = session.artifact_writer(path)
+            else:
+                from .artifacts import ArtifactWriter
+
+                self._fh = ArtifactWriter(path)
 
     @staticmethod
     def _compiles() -> int:
@@ -69,6 +70,22 @@ class RequestTracer:
 
     def _recorder(self):
         return self.session.recorder if self.session is not None else None
+
+    @staticmethod
+    def _exemplar(rec: dict) -> dict:
+        """The exemplar descriptor stamped onto histogram observations:
+        the live request's id (+ serving replica, when known) — what lets
+        a p99 bucket name the concrete request that put it there. Built
+        once per request at submit (``rec["_exemplar"]``) — the per-token
+        hook reuses it, and ``on_finish`` strips it before the JSONL
+        record lands."""
+        ex = rec.get("_exemplar")
+        if ex is None:
+            ex = rec["_exemplar"] = {"request_id": rec["request_id"]}
+            replica = rec.get("replica")
+            if replica:
+                ex["replica"] = replica
+        return ex
 
     # -- engine hooks (one call per lifecycle event) -----------------------
 
@@ -109,7 +126,9 @@ class RequestTracer:
         rec["slot"] = int(slot)
         rec["queue_wait_ms"] = round(queue_wait_s * 1e3, 3)
         rec["last_event"] = ("admission", time.time())
-        self.session.histogram("serving/queue_wait").add(queue_wait_s)
+        self.session.histogram("serving/queue_wait").observe(
+            queue_wait_s, exemplar=self._exemplar(rec)
+        )
         recorder = self._recorder()
         if recorder is not None:
             recorder.emit("serving/queue_wait", req.submit_t, queue_wait_s,
@@ -165,7 +184,9 @@ class RequestTracer:
         rec["ttft_ms"] = round(ttft_s * 1e3, 3)
         rec["tokens"] = 1
         rec["last_event"] = ("first_token", time.time())
-        self.session.histogram("serving/ttft").add(ttft_s)
+        self.session.histogram("serving/ttft").observe(
+            ttft_s, exemplar=self._exemplar(rec)
+        )
 
     def on_token(self, req, gap_s: float, token_index: int):
         """One decode token after the first; ``gap_s`` is the inter-token
@@ -177,7 +198,9 @@ class RequestTracer:
         if len(rec["itl_ms"]) < self.itl_series_max:
             rec["itl_ms"].append(round(gap_s * 1e3, 3))
         rec["last_event"] = ("token", time.time())
-        self.session.histogram("serving/itl").add(gap_s)
+        self.session.histogram("serving/itl").observe(
+            gap_s, exemplar=self._exemplar(rec)
+        )
         n = self.token_span_every
         # externally-supplied ids may be strings; hash keeps the 1-in-N
         # sampling property without constraining the id type
@@ -196,6 +219,7 @@ class RequestTracer:
             return
         rec.pop("state", None)
         rec.pop("last_event", None)
+        rec.pop("_exemplar", None)
         rec["finish_reason"] = reason
         # the definite-outcome contract: finished | shed | cancelled (the
         # engine sets it at the single terminal transition; "finished" is
@@ -241,8 +265,7 @@ class RequestTracer:
             rec["itl_max_ms"] = s[-1]
         with self._lock:  # two engines can drain finishes concurrently
             if self._fh is not None and not self._fh.closed:
-                self._fh.write(json.dumps(rec) + "\n")
-                self._fh.flush()
+                self._fh.write_line(json.dumps(rec))
             self.records_written += 1
         recorder = self._recorder()
         if recorder is not None:
@@ -266,6 +289,7 @@ class RequestTracer:
             for rec in live:
                 rec.pop("state", None)
                 rec.pop("last_event", None)
+                rec.pop("_exemplar", None)
                 rec["finish_reason"] = "evicted"
                 rec["outcome"] = "evicted"
                 rec["finish_unix_s"] = round(now, 6)
@@ -274,10 +298,8 @@ class RequestTracer:
                     self._compiles() - rec.pop("compiles_at_submit")
                 )
                 if self._fh is not None and not self._fh.closed:
-                    self._fh.write(json.dumps(rec) + "\n")
+                    self._fh.write_line(json.dumps(rec))
                 self.records_written += 1
-            if live and self._fh is not None and not self._fh.closed:
-                self._fh.flush()
 
     # -- consumers ---------------------------------------------------------
 

@@ -25,7 +25,6 @@ the post-mortem shows what the host was doing right before the hang.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from collections import deque
@@ -49,10 +48,9 @@ class SpanRecorder:
         # trace starts near 0 (viewers dislike 10^9-microsecond offsets)
         self._epoch = time.perf_counter()
         self._lock = threading.Lock()
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        self._fh = open(path, "a")
+        from .artifacts import ArtifactWriter
+
+        self._fh = ArtifactWriter(path)
         self._write({
             "name": "process_name", "ph": "M", "pid": process_index, "tid": 0,
             "args": {"name": f"host{process_index}", "epoch_unix_s": time.time()},
@@ -79,8 +77,7 @@ class SpanRecorder:
         with self._lock:
             if self._fh.closed:
                 return
-            self._fh.write(json.dumps(obj) + "\n")
-            self._fh.flush()
+            self._fh.write_line(json.dumps(obj))
 
     def close(self):
         with self._lock:

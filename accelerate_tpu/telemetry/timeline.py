@@ -104,6 +104,7 @@ class Timeline:
         self.last_t: Optional[float] = None
         self._keys: set = set()
         self._pending: deque = deque(maxlen=4096)  # unwritten JSONL samples
+        self._writers: dict = {}  # flush path -> ArtifactWriter
         self._lock = threading.Lock()
 
     # -- producers ---------------------------------------------------------
@@ -235,17 +236,18 @@ class Timeline:
         record, a torn tail line is skipped by the loader."""
         with self._lock:
             pending, self._pending = list(self._pending), deque(maxlen=4096)
-        if not pending:
-            return 0
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(path, "a") as fh:
-            for t, values in pending:
-                fh.write(json.dumps(
-                    {"t": round(t, 3),
-                     "v": {k: round(v, 6) for k, v in values.items()}}
-                ) + "\n")
+            if not pending:
+                return 0
+            writer = self._writers.get(path)
+            if writer is None:
+                from .artifacts import ArtifactWriter
+
+                writer = self._writers[path] = ArtifactWriter(path)
+        for t, values in pending:
+            writer.write_line(json.dumps(
+                {"t": round(t, 3),
+                 "v": {k: round(v, 6) for k, v in values.items()}}
+            ))
         return len(pending)
 
 
@@ -254,30 +256,21 @@ def load_timeline(target: str, tiers=None) -> Timeline:
     under ``target`` (a directory) or from one file path — the offline
     path ``accelerate-tpu report``/``watch`` use. Multi-host samples are
     merged in timestamp order; malformed lines are skipped."""
-    import glob
+    from .artifacts import artifact_files, iter_jsonl
 
     if os.path.isdir(target):
-        paths = sorted(glob.glob(os.path.join(target, "timeline-host*.jsonl")))
+        paths = artifact_files(target, "timeline-host*.jsonl")
     elif os.path.exists(target):
-        paths = [target]
+        paths = artifact_files(target)
     else:
         paths = []
     records = []
-    for path in paths:
-        try:
-            with open(path) as fh:
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue
-                    if isinstance(rec, dict) and "t" in rec and isinstance(rec.get("v"), dict):
-                        records.append((float(rec["t"]), rec["v"]))
-        except OSError:
-            continue
+    for rec in iter_jsonl(paths):
+        if "t" in rec and isinstance(rec.get("v"), dict):
+            try:
+                records.append((float(rec["t"]), rec["v"]))
+            except (TypeError, ValueError):
+                continue
     records.sort(key=lambda r: r[0])
     tl = Timeline(tiers=tiers)
     for t, values in records:

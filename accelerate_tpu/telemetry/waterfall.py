@@ -31,8 +31,6 @@ the waterfall is computed wherever the log files land.
 
 from __future__ import annotations
 
-import glob
-import json
 import os
 from typing import Optional
 
@@ -49,29 +47,17 @@ STAGES = ("router_queue", "placement", "retry_backoff", "transport",
 def load_router_requests(target) -> list:
     """Every router request record under the dir(s)/file(s) —
     ``router-requests*.jsonl`` written by a ``Router(log_dir=...)``."""
+    from .artifacts import artifact_files, iter_jsonl
+
     targets = [target] if isinstance(target, str) else list(target)
     paths = []
     for t in targets:
         if os.path.isdir(t):
-            paths.extend(sorted(glob.glob(os.path.join(t, "router-requests*.jsonl"))))
+            paths.extend(artifact_files(t, "router-requests*.jsonl"))
         elif os.path.basename(t).startswith("router-requests"):
-            paths.append(t)
-    out = []
-    for path in paths:
-        try:
-            with open(path) as fh:
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue
-                    if isinstance(rec, dict) and rec.get("request_id") is not None:
-                        out.append(rec)
-        except OSError:
-            continue
+            paths.extend(artifact_files(t))
+    out = [rec for rec in iter_jsonl(paths)
+           if rec.get("request_id") is not None]
     out.sort(key=lambda r: r.get("submit_unix_s", 0))
     return out
 

@@ -100,9 +100,10 @@ class JSONLTracker(GeneralTracker):
     def __init__(self, run_name: str, logging_dir: Union[str, os.PathLike]):
         super().__init__()
         self.run_name = run_name
-        os.makedirs(os.path.join(logging_dir, run_name), exist_ok=True)
+        from .telemetry.artifacts import ArtifactWriter
+
         self.path = os.path.join(logging_dir, run_name, "metrics.jsonl")
-        self._fh = open(self.path, "a")
+        self._fh = ArtifactWriter(self.path)
 
     @property
     def tracker(self):
@@ -117,8 +118,7 @@ class JSONLTracker(GeneralTracker):
         self._write({"event": "log", "step": step, "time": time.time(), "values": _jsonable(values)})
 
     def _write(self, obj):
-        self._fh.write(json.dumps(obj) + "\n")
-        self._fh.flush()
+        self._fh.write_line(json.dumps(obj))
 
     @on_main_process
     def finish(self):

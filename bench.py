@@ -2199,6 +2199,161 @@ def _pipeline_mem_bench() -> dict:
         return {}
 
 
+def _incident_bench(n_incidents=3, n_requests=400, n_decisions=600,
+                    observe_n=20_000):
+    """Observability economics rows (telemetry/incidents.py + the exemplar
+    reservoir), jax-free so the numbers mean the same thing on both
+    branches:
+
+    - ``exemplar_trace_ratio`` — request-tracker event throughput (the
+      full submit→admit→token×N→finish lifecycle, JSONL record and SLO
+      histograms armed) with the exemplar reservoir ON vs OFF — the
+      zero-overhead witness at the production observation site (>= 0.7x
+      asserted: exemplars are designed to stay on, same contract as the
+      serving/train tracing witnesses);
+    - ``incident_reconstruct_ms`` — wall time of ``reconstruct_incidents``
+      over a synthetic artifact dir sized like a real drill (alert
+      windows + request records + placement decisions + health flaps),
+      with the exemplar join asserted to land on the right stage.
+    """
+    import tempfile
+
+    from accelerate_tpu.telemetry.artifacts import ArtifactWriter
+    from accelerate_tpu.telemetry.histograms import StreamingHistogram
+    from accelerate_tpu.telemetry.incidents import reconstruct_incidents
+    from accelerate_tpu.telemetry.requests import RequestTracer
+
+    # -- exemplar zero-overhead witness ------------------------------------
+    class _Session:  # the tracer's session surface, histograms only
+        recorder = None
+        flight = None
+
+        def __init__(self, exemplars):
+            self._hists = {}
+            self._exemplars = exemplars
+
+        def histogram(self, name):
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = StreamingHistogram()
+                h.exemplars_enabled = self._exemplars
+            return h
+
+    class _Req:
+        def __init__(self, i, tokens):
+            self.id = f"req-{i}"
+            self.prompt = np.zeros((64,), np.int32)
+            self.max_new_tokens = tokens
+            self.submit_t = time.perf_counter()
+            self.finish_t = None
+            self.replica = "r0"
+            self.outcome = "finished"
+
+    tokens = 64
+    n_req = max(1, observe_n // tokens)
+
+    def wave(exemplars, path):
+        tracer = RequestTracer(_Session(exemplars), path=path)
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            req = _Req(i, tokens)
+            tracer.on_submit(req)
+            tracer.on_admission(req, 0, 0.002)
+            tracer.on_first_token(req, 0.02)
+            for k in range(1, tokens):
+                tracer.on_token(req, 0.004, k)
+            req.finish_t = time.perf_counter()
+            tracer.on_finish(req, "eos")
+        dt = time.perf_counter() - t0
+        tracer.close()
+        return n_req * tokens / dt
+
+    with tempfile.TemporaryDirectory(prefix="att_bench_exemplar_") as tdir:
+        def path(tag):
+            return os.path.join(tdir, f"requests-{tag}.jsonl")
+
+        wave(True, path("w0")), wave(False, path("w1"))  # warm both paths
+        rate_on = max(wave(True, path(f"on{i}")) for i in range(3))
+        rate_off = max(wave(False, path(f"off{i}")) for i in range(3))
+    ratio = rate_on / rate_off
+    assert ratio >= 0.7, (
+        f"exemplar reservoir cost {100 * (1 - ratio):.1f}% of request-"
+        f"tracing throughput ({rate_on:,.0f} vs {rate_off:,.0f} events/s) "
+        "— the always-on exemplar contract broke"
+    )
+
+    # -- incident reconstruction wall --------------------------------------
+    base = 1_700_000_000.0
+    with tempfile.TemporaryDirectory(prefix="att_bench_incident_") as tdir:
+        def writer(name):
+            return ArtifactWriter(os.path.join(tdir, name))
+
+        culprits = [f"cul-{k}" for k in range(n_incidents)]
+        fh = writer("alerts-host0.jsonl")
+        for k in range(n_incidents):
+            t = base + 120.0 * k
+            for state, dt, kv in (
+                ("pending", 0.0, {}),
+                ("firing", 6.0, {"exemplars": [culprits[k]]}),
+                ("resolved", 30.0, {}),
+            ):
+                fh.write_line(json.dumps({
+                    "t_unix_s": t + dt, "rule": "itl_burn_rate",
+                    "state": state, "value": 2.0 + k, "severity": "page",
+                    "description": "bench synthetic", **kv,
+                }))
+        fh.close()
+        fh = writer("requests-host0.jsonl")
+        for i in range(n_requests):
+            rid = culprits[i] if i < n_incidents else f"req-{i}"
+            t = base + 120.0 * (i % n_incidents) + 8.0
+            fh.write_line(json.dumps({
+                "request_id": rid, "replica": "r0",
+                "queue_wait_ms": 2.0, "kv_restore_ms": 1.0,
+                "ttft_ms": 20.0, "total_ms": 520.0, "tokens": 32,
+                "submit_unix_s": t, "finish_unix_s": t + 0.52,
+            }))
+        fh.close()
+        fh = writer("router-decisions.jsonl")
+        for i in range(n_decisions):
+            fh.write_line(json.dumps({
+                "t_unix_s": base + 120.0 * (i % n_incidents) + 7.0,
+                "request_id": f"req-{i}", "hop": 0, "chosen": "r0",
+                "reason": "least_loaded",
+            }))
+        fh.close()
+        fh = writer("fleet-events.jsonl")
+        for k in range(n_incidents):
+            fh.write_line(json.dumps({
+                "t_unix_s": base + 120.0 * k + 5.0, "replica": "r0",
+                "from": "healthy", "to": "degraded", "reason": "itl breach",
+            }))
+        fh.close()
+
+        for _ in range(2):  # warm the import + OS cache
+            incidents = reconstruct_incidents(tdir)
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            incidents = reconstruct_incidents(tdir)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+    assert len(incidents) == n_incidents, incidents
+    joined = [r for i in incidents for r in i["exemplar_requests"]
+              if not r.get("missing")]
+    assert joined and all(r["top_stage"] == "decode" for r in joined), (
+        "incident exemplar join did not attribute the synthetic decode "
+        f"stall to the decode stage: {joined}"
+    )
+    return {
+        "incident_reconstruct_ms": round(best * 1e3, 2),
+        "incident_exemplars_joined": len(joined),
+        "exemplar_trace_ratio": round(ratio, 3),
+        "exemplar_trace_overhead_pct": round(100 * (1 - ratio), 2),
+        "exemplar_trace_events_per_sec": round(rate_on),
+    }
+
+
 def _audit_rows():
     """Post-warmup static-audit pass (`accelerate-tpu audit` in-process):
     host lint + import hygiene + the program auditor over a warmed tiny
@@ -2701,6 +2856,11 @@ def main():
         for key in ("autoscale_reaction_s", "fleet_capacity_tokens_per_s",
                     "fleet_headroom_frac"):
             extra[key] = extra["autoscale"].get(key)
+
+    # observability economics rows (both branches, jax-free): incident
+    # reconstruction wall + the exemplar zero-overhead witness — report
+    # --diff grades both like any other perf row
+    extra.update(_incident_bench())
 
     # static-audit regression rows (both branches; post-warmup pass)
     extra.update(_audit_rows())

@@ -151,6 +151,40 @@ class TestHostLintCorpus:
         )
         assert host_lint.lint_source(src, "good.py") == []
 
+    def test_unbounded_artifact_append_flagged(self):
+        src = (
+            "import os, json\n"
+            "def log_direct(rec):\n"
+            "    with open('events.jsonl', 'a') as fh:\n"
+            "        fh.write(json.dumps(rec) + '\\n')\n"
+            "def log_joined(d, rec):\n"
+            "    with open(os.path.join(d, 'alerts-host0.jsonl'), mode='at') as fh:\n"
+            "        fh.write(json.dumps(rec) + '\\n')\n"
+        )
+        fs = host_lint.lint_source(src, "telemetry/whatever.py")
+        appends = [f for f in fs if f.check == "artifact-append"]
+        assert len(appends) == 2
+        assert all(f.severity == "P2" for f in appends)
+        assert "ArtifactWriter" in appends[0].message
+
+    def test_artifact_append_exempts_the_writer_and_bounded_io(self):
+        src = (
+            "def read(path):\n"
+            "    with open('events.jsonl') as fh:\n"       # read, not append
+            "        return fh.read()\n"
+            "def log_txt(rec):\n"
+            "    with open('notes.txt', 'a') as fh:\n"      # not a JSONL family
+            "        fh.write(rec)\n"
+        )
+        assert [f for f in host_lint.lint_source(src, "x.py")
+                if f.check == "artifact-append"] == []
+        # the one place append-mode JSONL opens are the implementation:
+        writer_src = "fh = open(path + '.jsonl', 'ab', buffering=0)\n"
+        assert host_lint.lint_source(
+            writer_src, "accelerate_tpu/telemetry/artifacts.py") == []
+        hit = host_lint.lint_source(writer_src, "elsewhere.py")
+        assert [f.check for f in hit] == ["artifact-append"]
+
     def test_repo_host_tree_is_clean(self):
         fs = host_lint.lint_paths()
         assert fs == [], [f.to_dict() for f in fs]

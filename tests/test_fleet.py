@@ -139,6 +139,84 @@ class TestExpositionParser:
         snap = parse_exposition("att_x 2.0 1700000000\n")
         assert snap.gauges["x"] == 2.0
 
+    def test_help_and_type_metadata_render_and_round_trip(self):
+        sess = StubReplicaSession()
+        sess.hists["serving/itl"].add(0.02)
+        text = prometheus_text(sess)
+        assert "# HELP att_serving_tokens_per_s serving/tokens_per_s" in text
+        assert "# TYPE att_serving_tokens_per_s gauge" in text
+        assert "# HELP att_serving_itl_seconds serving/itl latency histogram" in text
+        assert "# TYPE att_serving_itl_seconds histogram" in text
+        snap = parse_exposition(text)
+        # metadata lines are skipped without being counted as torn input
+        assert snap.skipped_lines == 0
+        assert snap.gauges["serving_tokens_per_s"] == 100.0
+        assert snap.histograms["serving_itl"]["count"] == 1
+
+    def test_exemplar_suffix_round_trips_with_hostile_labels(self):
+        h = StreamingHistogram()
+        # a request id that exercises every escape class the label
+        # grammar allows, plus a replica label riding along
+        rid = 'req "q" \\slash\nnewline'
+        h.observe(0.02, exemplar={"request_id": rid, "replica": "r0"})
+        h.observe(0.5, exemplar={"request_id": "big-one"})
+        sess = StubReplicaSession()
+        sess.hists = {"serving/ttft": h}
+        text = prometheus_text(sess)
+        assert " # {request_id=" in text  # OpenMetrics suffix rendered
+        snap = parse_exposition(text)
+        data = snap.histograms["serving_ttft"]
+        parsed = {e["request_id"]: e for _, e in data["exemplars"]}
+        assert set(parsed) == {rid, "big-one"}
+        assert parsed["big-one"]["value"] == pytest.approx(0.5)
+        assert parsed[rid]["replica"] == "r0"
+        assert parsed[rid]["unix_s"] > 0
+        # and the rebuilt histogram carries them into fleet merges
+        rebuilt = StreamingHistogram.from_cumulative(
+            data["buckets"], sum_value=data["sum"], exemplars=data["exemplars"]
+        )
+        ids = {e["request_id"] for res in rebuilt.exemplars.values() for e in res}
+        assert ids == {rid, "big-one"}
+
+    def test_hostile_and_torn_exemplar_suffixes_cost_only_themselves(self):
+        text = (
+            'att_h_seconds_bucket{le="0.1"} 3 # {request_id="ok"} 0.09 1.5\n'
+            'att_h_seconds_bucket{le="0.2"} 4 # {request_id="torn\n'
+            'att_h_seconds_bucket{le="0.4"} 5 # {} 0.3\n'
+            'att_h_seconds_bucket{le="0.8"} 6 # {request_id="noval"}\n'
+            'att_h_seconds_bucket{le="1.6"} 7 # {request_id="nanval"} NaN\n'
+            'att_h_seconds_bucket{le="3.2"} 8 # garbage trailing junk\n'
+            'att_g 1.0 # {request_id="on-a-gauge"} 9.9\n'
+            "att_h_seconds_sum 1.0\n"
+            "att_h_seconds_count 8\n"
+        )
+        snap = parse_exposition(text)
+        data = snap.histograms["h"]
+        # every bucket count parsed despite its suffix's condition...
+        assert [c for _, c in data["buckets"]] == [3, 4, 5, 6, 7, 8]
+        # ...but only the well-formed exemplar survived
+        assert [(le, e["request_id"]) for le, e in data["exemplars"]] == [
+            (0.1, "ok")
+        ]
+        # a suffix on a non-bucket line parses the gauge, drops the hint
+        assert snap.gauges["g"] == 1.0
+
+    def test_merge_histograms_unions_exemplars_bounded(self):
+        from accelerate_tpu.telemetry.histograms import EXEMPLARS_PER_BUCKET
+
+        snaps = []
+        for rep in range(4):
+            h = StreamingHistogram()
+            h.observe(0.02, exemplar={"request_id": f"req-{rep}",
+                                      "replica": f"r{rep}"})
+            sess = StubReplicaSession()
+            sess.hists = {"serving/itl": h}
+            snaps.append(parse_exposition(prometheus_text(sess)).histograms)
+        merged = merge_histograms(snaps)["serving_itl"]
+        assert merged.count == 4
+        for res in merged.exemplars.values():
+            assert len(res) <= EXEMPLARS_PER_BUCKET
+
     def test_unflatten_restores_known_namespaces(self):
         assert unflatten_key("serving_itl_recent_p99_ms") == "serving/itl_recent_p99_ms"
         assert unflatten_key("usage_acme_decode_tokens") == "usage/acme_decode_tokens"

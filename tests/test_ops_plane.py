@@ -165,6 +165,13 @@ class TestAlertDrill:
             states = [e["state"] for e in events if e["rule"] == "itl_burn_rate"]
             assert "pending" in states and "firing" in states and "resolved" in states
             assert states.index("pending") < states.index("firing") < states.index("resolved")
+            # the firing edge named culprit requests off the ITL
+            # histogram's live exemplar reservoirs
+            firing = [e for e in events if e["state"] == "firing"]
+            assert any(e.get("exemplars") for e in firing), (
+                "no exemplars stamped at the firing edge — the "
+                "histogram -> alert culprit link is broken"
+            )
 
             # per-tenant usage reconciles EXACTLY against the engine
             totals = session.usage.totals()
@@ -180,6 +187,42 @@ class TestAlertDrill:
                 )
         finally:
             session.close()
+
+        # ---- the offline half of the drill: incident reconstruction ----
+        # Everything below runs from the artifact dir ALONE (the session
+        # is closed): the alert window, the cross-plane timeline, and the
+        # exemplar whose stage breakdown blames the injected decode delay.
+        from accelerate_tpu.telemetry.incidents import reconstruct_incidents
+
+        incidents = [i for i in reconstruct_incidents(str(tmp_path))
+                     if i["rule"] == "itl_burn_rate"]
+        assert incidents, "drill produced no reconstructable incident"
+        inc = incidents[-1]
+        assert inc["state"] == "resolved" and inc["duration_s"] > 0
+        ts = [e["t_unix_s"] for e in inc["events"]]
+        assert ts == sorted(ts)
+        kinds = [(e["source"], e["kind"]) for e in inc["events"]]
+        assert ("alert", "firing") in kinds and ("alert", "resolved") in kinds
+        # >= 1 culprit joined to its replica record, and its breakdown
+        # attributes the injected per-step delay to the decode stage
+        joined = [r for r in inc["exemplar_requests"] if not r.get("missing")]
+        assert joined, inc["exemplar_requests"]
+        assert any(r["top_stage"] == "decode" for r in joined), joined
+        # and the CLI renders the same story from the same files
+        import argparse
+        import io
+        from contextlib import redirect_stdout
+
+        from accelerate_tpu.commands.incident import incident_command
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert incident_command(argparse.Namespace(
+                action="show", target=str(tmp_path), index=inc["index"],
+                rule=None, pad_s=30.0, json=False)) == 0
+        text = buf.getvalue()
+        assert "itl_burn_rate" in text and "timeline:" in text
+        assert "exemplar requests:" in text
 
     def test_drill_artifacts_render_in_report_and_watch(self, ops_model, tmp_path):
         """The offline halves: after a (small) traced wave, `report`

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import time
 import types
 
@@ -478,6 +479,167 @@ class TestStreamingHistogram:
         out = percentile_keys("serving/ttft", h)
         assert out["serving/ttft_count"] == 1
         assert out["serving/ttft_p99_ms"] == pytest.approx(100, rel=0.13)
+
+
+class TestExemplarReservoir:
+    """The bounded exemplar reservoir behind every SLO histogram: at most
+    EXEMPLARS_PER_BUCKET entries per bucket at any observation rate, the
+    max-valued entry always retained, the newest always reachable, and
+    the fleet-merge union holding the same bound."""
+
+    def test_bounded_under_10k_observations(self):
+        from accelerate_tpu.telemetry.histograms import (
+            EXEMPLARS_PER_BUCKET,
+            StreamingHistogram,
+        )
+
+        rng = np.random.RandomState(0)
+        h = StreamingHistogram()
+        worst = 0.0
+        for i in range(10_000):
+            v = float(rng.lognormal(mean=-3.0, sigma=1.0))
+            worst = max(worst, v)
+            h.observe(v, exemplar={"request_id": f"req-{i}", "replica": "r0"})
+        assert h.count == 10_000
+        for res in h.exemplars.values():
+            assert 1 <= len(res) <= EXEMPLARS_PER_BUCKET
+        # the max-valued observation survived 10k displacement attempts
+        from accelerate_tpu.telemetry.histograms import _entry_value
+
+        kept = [e for res in h.exemplars.values() for e in res]
+        assert max(_entry_value(e) for e in kept) == pytest.approx(worst)
+        # a tail quantile names a concrete culprit from a nearby bucket
+        near = h.exemplar_near_quantile(0.999)
+        assert near is not None and near["value"] >= h.quantile(0.99) * 0.8
+        # the per-bucket exposition pick is the NEWEST entry, and it
+        # carries the normalized schema regardless of storage form
+        for le, entry in h.exposition_exemplars().items():
+            assert set(entry) >= {"request_id", "value", "unix_s"}
+            assert entry["value"] <= le * 1.0001
+            assert entry["replica"] == "r0"
+
+    def test_disabled_and_anonymous_observations_cost_nothing(self):
+        from accelerate_tpu.telemetry.histograms import StreamingHistogram
+
+        h = StreamingHistogram()
+        h.exemplars_enabled = False
+        h.observe(0.1, exemplar={"request_id": "req-0"})
+        h.observe(0.2)  # no exemplar at all
+        h.exemplars_enabled = True
+        h.observe(0.3, exemplar={"replica": "r0"})  # no request_id: dropped
+        assert h.count == 3 and h.exemplars == {}
+        assert h.exemplar_near_quantile(0.99) is None
+
+    def test_merge_unions_bounded_newest_wins(self):
+        from accelerate_tpu.telemetry.histograms import (
+            EXEMPLARS_PER_BUCKET,
+            StreamingHistogram,
+        )
+
+        a, b = StreamingHistogram(), StreamingHistogram()
+        # same bucket on both sides: four candidate entries, bound is 2;
+        # "a-max" carries the largest value, "b-new" the newest timestamp
+        for h, rid, v, t in [(a, "a-old", 0.1000, 10.0), (a, "a-max", 0.1040, 20.0),
+                             (b, "b-mid", 0.1010, 30.0), (b, "b-new", 0.1020, 40.0)]:
+            h.observe(v, exemplar={"request_id": rid, "unix_s": t})
+        a.merge(b)
+        assert len(a.exemplars) == 1
+        (res,) = a.exemplars.values()
+        assert len(res) <= EXEMPLARS_PER_BUCKET
+        ids = {e["request_id"] for e in res}
+        # the union keeps the max-valued entry and the newest entry
+        assert ids == {"a-max", "b-new"}
+        assert res[0]["request_id"] == "a-max"  # max first (reservoir invariant)
+
+    def test_percentile_keys_name_p99_culprit(self):
+        from accelerate_tpu.telemetry.histograms import (
+            StreamingHistogram,
+            percentile_keys,
+        )
+
+        h = StreamingHistogram()
+        for i in range(97):
+            h.observe(0.010, exemplar={"request_id": f"fast-{i}"})
+        for i in range(3):  # ~3% of traffic blows the SLO: p99 lands here
+            h.observe(1.5, exemplar={"request_id": f"slow-{i}"})
+        out = percentile_keys("serving/itl", h)
+        assert out["serving/itl_p99_exemplar"].startswith("slow-")
+        # rollup stays numeric-typed everywhere else
+        assert isinstance(out["serving/itl_p99_ms"], float)
+
+    def test_alert_exemplars_for_key_reads_live_reservoirs(self):
+        from accelerate_tpu.telemetry.alerts import exemplars_for_key
+        from accelerate_tpu.telemetry.histograms import StreamingHistogram
+
+        h = StreamingHistogram()
+        for i, v in enumerate((0.01, 0.02, 0.9, 0.05)):
+            h.observe(v, exemplar={"request_id": f"req-{i}"})
+        ids = exemplars_for_key({"serving/itl": h}, "serving/itl_recent_p99_ms")
+        assert ids and ids[0] == "req-2"  # worst value leads
+        assert exemplars_for_key({"serving/itl": h}, "fleet/replicas") == []
+
+
+class TestArtifactWriter:
+    """Durable JSONL retention: rotation below the byte cap, bounded
+    generations, continuous multi-generation reads, and a torn tail that
+    never costs more than itself."""
+
+    def test_rotation_stays_bounded_with_zero_reader_errors(self, tmp_path):
+        from accelerate_tpu.telemetry.artifacts import (
+            ArtifactWriter,
+            artifact_files,
+            read_jsonl,
+        )
+
+        path = str(tmp_path / "requests-host0.jsonl")
+        w = ArtifactWriter(path, max_bytes=4096, max_generations=3)
+        n = 2000
+        for i in range(n):
+            w.write({"request_id": f"req-{i}", "seq": i, "pad": "x" * 40})
+        w.close()
+        assert w.rotations > 3  # the cap actually engaged, repeatedly
+        files = artifact_files(str(tmp_path), "requests-host*.jsonl")
+        # bounded footprint: active + at most max_generations rotated
+        assert 1 <= len(files) <= 4
+        for f in files:
+            assert os.path.getsize(f) <= 4096 + 256  # cap + one record slack
+        recs = read_jsonl(str(tmp_path), "requests-host*.jsonl")
+        # oldest-generation-first means seq is strictly increasing and
+        # the newest record always survives rotation
+        seqs = [r["seq"] for r in recs]
+        assert seqs == sorted(seqs)
+        assert seqs[-1] == n - 1
+
+    def test_torn_tail_skipped_earlier_records_intact(self, tmp_path):
+        from accelerate_tpu.telemetry.artifacts import ArtifactWriter, read_jsonl
+
+        path = str(tmp_path / "alerts-host0.jsonl")
+        w = ArtifactWriter(path)
+        for i in range(5):
+            w.write({"seq": i})
+        w.close()
+        with open(path, "ab") as fh:  # a kill -9 mid-append
+            fh.write(b'{"seq": 5, "never_fini')
+        recs = read_jsonl(path)
+        assert [r["seq"] for r in recs] == [0, 1, 2, 3, 4]
+
+    def test_family_loaders_read_across_generations(self, tmp_path):
+        from accelerate_tpu.telemetry.alerts import load_alerts
+        from accelerate_tpu.telemetry.artifacts import ArtifactWriter
+
+        path = str(tmp_path / "alerts-host0.jsonl")
+        w = ArtifactWriter(path, max_bytes=512, max_generations=2)
+        n = 40
+        for i in range(n):
+            w.write({"rule": "itl_burn_rate", "state": "firing",
+                     "t_unix_s": 1000.0 + i, "severity": "page"})
+        w.close()
+        assert w.rotations > 0
+        events = load_alerts(str(tmp_path)).get("events")
+        # rotated-away history is gone by design; what survives is the
+        # continuous suffix, in order, ending at the newest event
+        ts = [e["t_unix_s"] for e in events]
+        assert ts == sorted(ts) and ts[-1] == 1000.0 + n - 1
 
 
 class TestRecompileForensics:
